@@ -1,0 +1,115 @@
+"""North-star benchmark: batched scheduling throughput on TPU.
+
+Schedules a 1M-task synthetic workload (grouped into scheduling classes)
+across a 10k-node simulated cluster with the JAX kernel, and reports
+scheduling decisions/sec (median round). BASELINE.md's nearest reference
+anchor is the distributed scheduling throughput test
+(release/benchmarks/distributed/test_scheduling.py), O(1e3) decisions/s per
+raylet; baseline here = 1e4/s (a 10-raylet cluster's aggregate).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Diagnostics go to stderr.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_DECISIONS_PER_SEC = 1e4
+
+N_NODES = 10_000
+N_CLASSES = 256
+N_TASKS = 1_000_000
+R = 16
+ROUNDS = 7
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def build_problem(rng):
+    # Heterogeneous cluster sized so aggregate demand ~= 80% of capacity
+    # (a loaded-but-feasible cluster, the regime the north star targets).
+    total = np.zeros((N_NODES, R), np.float32)
+    total[:, 0] = rng.integers(128, 513, N_NODES)  # CPU
+    total[:, 2] = np.where(rng.random(N_NODES) < 0.2, 8.0, 0.0)  # TPU
+    total[:, 3] = rng.integers(512, 4097, N_NODES)  # memory (GB-ish units)
+    alive = np.ones(N_NODES, bool)
+
+    # Mixed classes: mostly small CPU tasks, some memory-heavy, some TPU.
+    demands = np.zeros((N_CLASSES, R), np.float32)
+    demands[:, 0] = rng.integers(1, 5, N_CLASSES)
+    heavy = rng.random(N_CLASSES) < 0.3
+    demands[heavy, 3] = rng.integers(1, 9, heavy.sum())
+    tpu = rng.random(N_CLASSES) < 0.1
+    demands[tpu, 2] = rng.integers(1, 3, tpu.sum())
+    counts = rng.multinomial(N_TASKS, np.ones(N_CLASSES) / N_CLASSES).astype(np.int32)
+    # scale CPU so demand/capacity ~= 0.8 on the critical resource
+    cpu_demand = float((demands[:, 0] * counts).sum())
+    total[:, 0] *= np.float32(cpu_demand / 0.8 / total[:, 0].sum())
+    total[:, 0] = np.maximum(np.round(total[:, 0]), 1)
+    return total, alive, demands, counts
+
+
+def main():
+    import jax
+
+    try:  # persistent compile cache: first bench run pays compile, rest don't
+        jax.config.update("jax_compilation_cache_dir", "/tmp/ray_tpu_jax_cache")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+    import jax.numpy as jnp
+
+    from ray_tpu.sched import kernel_jax
+
+    dev = jax.devices()[0]
+    log(f"bench device: {dev}")
+    rng = np.random.default_rng(0)
+    total, alive, demands, counts = build_problem(rng)
+
+    sched = kernel_jax.JaxScheduler(total, alive, device=dev)
+    d_dev = jax.device_put(jnp.asarray(demands), dev)
+    k_dev = jax.device_put(jnp.asarray(counts), dev)
+    total_dev = sched.total
+    alive_dev = sched.alive
+
+    def one_round():
+        avail = total_dev  # fresh cluster each round
+        assigned, _ = kernel_jax.schedule_classes(
+            avail, total_dev, alive_dev, d_dev, k_dev
+        )
+        return np.asarray(assigned.sum())  # forces device->host sync
+
+    t0 = time.time()
+    placed = one_round()  # compile
+    log(f"compile+first round: {time.time()-t0:.2f}s, placed={int(placed)}/{N_TASKS}")
+
+    times = []
+    for i in range(ROUNDS):
+        t0 = time.perf_counter()
+        placed = one_round()
+        times.append(time.perf_counter() - t0)
+    t_round = float(np.median(times))
+    decisions = int(placed)
+    value = decisions / t_round
+    log(f"round times: {[f'{t*1e3:.1f}ms' for t in times]}, median {t_round*1e3:.1f}ms")
+    log(f"placed {decisions}/{N_TASKS} tasks ({N_NODES} nodes, {N_CLASSES} classes)")
+
+    print(
+        json.dumps(
+            {
+                "metric": "sched_decisions_per_sec_1M_tasks_10k_nodes",
+                "value": round(value, 1),
+                "unit": "decisions/s",
+                "vs_baseline": round(value / BASELINE_DECISIONS_PER_SEC, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

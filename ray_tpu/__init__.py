@@ -1,0 +1,72 @@
+"""ray_tpu — a TPU-native distributed execution framework.
+
+A ground-up reimplementation of the capabilities of Ray (reference:
+``pchalasani/ray``, surveyed in SURVEY.md) designed TPU-first:
+
+- the cluster scheduler is a *batched assignment kernel* (NumPy reference +
+  JAX/jit twin that runs on TPU), not a per-task C++ loop
+  (reference: src/ray/raylet/scheduling/cluster_resource_scheduler.cc);
+- tensor collectives are XLA ICI collectives compiled into programs
+  (reference: python/ray/util/collective/ over NCCL/GLOO);
+- the data plane is a host shm object store + device HBM residency
+  (reference: src/ray/object_manager/plasma/).
+
+Public API surface mirrors the reference's Python core API
+(python/ray/_private/worker.py: init/get/put/wait; python/ray/remote_function.py
+and python/ray/actor.py: @remote).
+"""
+
+from ray_tpu._version import __version__
+
+from ray_tpu.core.api import (
+    init,
+    shutdown,
+    is_initialized,
+    remote,
+    get,
+    put,
+    wait,
+    cancel,
+    kill,
+    get_runtime_context,
+    method,
+    nodes,
+    cluster_resources,
+    available_resources,
+    timeline,
+)
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.exceptions import (
+    RayTpuError,
+    TaskError,
+    ActorError,
+    ActorDiedError,
+    ObjectLostError,
+    GetTimeoutError,
+)
+
+__all__ = [
+    "__version__",
+    "init",
+    "shutdown",
+    "is_initialized",
+    "remote",
+    "get",
+    "put",
+    "wait",
+    "cancel",
+    "kill",
+    "method",
+    "nodes",
+    "cluster_resources",
+    "available_resources",
+    "get_runtime_context",
+    "timeline",
+    "ObjectRef",
+    "RayTpuError",
+    "TaskError",
+    "ActorError",
+    "ActorDiedError",
+    "ObjectLostError",
+    "GetTimeoutError",
+]

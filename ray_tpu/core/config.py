@@ -1,0 +1,77 @@
+"""Config/flag system.
+
+Reference: src/ray/common/ray_config_def.h — a single X-macro list
+``RAY_CONFIG(type, name, default)`` with env override ``RAY_<name>`` and
+``ray.init(_system_config={...})``. Same model here: one declarative table,
+env override ``RAY_TPU_<name>``, programmatic override via
+``ray_tpu.init(_system_config=...)``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+# name -> (type, default)  — keep scheduler knobs named like the reference's
+# (scheduler_spread_threshold etc. in ray_config_def.h) for discoverability.
+_DEFS: Dict[str, tuple] = {
+    "scheduler_spread_threshold": (float, 0.5),
+    "scheduler_top_k_fraction": (float, 0.2),  # reserved; kernel is deterministic
+    "scheduling_policy": (str, "hybrid"),  # "hybrid" | "jax_tpu" | "spread"
+    "scheduler_round_interval_ms": (float, 2.0),
+    "max_direct_call_object_size": (int, 100 * 1024),  # inline-in-reply threshold
+    "worker_lease_timeout_ms": (float, 500.0),
+    "task_max_retries": (int, 3),
+    "actor_max_restarts": (int, 0),
+    "health_check_period_ms": (float, 1000.0),
+    "health_check_timeout_ms": (float, 5000.0),
+    "object_store_memory_bytes": (int, 256 * 1024 * 1024),
+    "object_spilling_dir": (str, ""),  # empty -> <session_dir>/spill
+    "object_transfer_chunk_bytes": (int, 1024 * 1024),
+    "memory_monitor_interval_ms": (float, 500.0),
+    "gcs_port": (int, 0),  # 0 -> pick free port
+    "num_workers_soft_limit": (int, 0),  # 0 -> num_cpus
+    "worker_start_timeout_s": (float, 30.0),
+    "metrics_report_interval_ms": (float, 2000.0),
+    "log_to_driver": (bool, True),
+    "session_dir_root": (str, "/tmp/ray_tpu"),
+}
+
+
+class Config:
+    def __init__(self, overrides: Dict[str, Any] | None = None):
+        self._values: Dict[str, Any] = {}
+        for name, (typ, default) in _DEFS.items():
+            env = os.environ.get(f"RAY_TPU_{name}")
+            if env is not None:
+                self._values[name] = _parse(typ, env)
+            else:
+                self._values[name] = default
+        for k, v in (overrides or {}).items():
+            if k not in _DEFS:
+                raise ValueError(f"unknown config key {k!r}")
+            self._values[k] = _parse(_DEFS[k][0], v)
+
+    def __getattr__(self, name: str):
+        try:
+            return self._values[name]
+        except KeyError:
+            raise AttributeError(name)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dict(self._values)
+
+
+def _parse(typ, val):
+    if typ is bool and isinstance(val, str):
+        return val.lower() in ("1", "true", "yes", "on")
+    return typ(val)
+
+
+GLOBAL_CONFIG = Config()
+
+
+def set_global_config(overrides: Dict[str, Any] | None) -> Config:
+    global GLOBAL_CONFIG
+    GLOBAL_CONFIG = Config(overrides)
+    return GLOBAL_CONFIG

@@ -1,0 +1,48 @@
+"""ObjectRef: a future naming an object in the distributed store.
+
+Reference: ObjectRef in python/ray/includes/object_ref.pxi / the ObjectID in
+src/ray/common/id.h. IDs here are 16-byte random (task-output ids are derived
+deterministically from task id + output index, mirroring
+ObjectID::FromIndex).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Optional
+
+
+def _rand_hex(n: int = 16) -> str:
+    return os.urandom(n).hex()
+
+
+class ObjectRef:
+    __slots__ = ("id", "owner", "task_id", "_hash")
+
+    def __init__(self, id: Optional[str] = None, owner: Optional[str] = None,
+                 task_id: Optional[str] = None):
+        self.id = id or _rand_hex()
+        self.owner = owner  # owner worker/driver id (ownership-based directory)
+        self.task_id = task_id  # creating task, for lineage reconstruction
+        self._hash = hash(self.id)
+
+    @staticmethod
+    def for_task_output(task_id: str, index: int, owner: Optional[str] = None) -> "ObjectRef":
+        oid = hashlib.sha1(f"{task_id}:{index}".encode()).hexdigest()[:32]
+        return ObjectRef(oid, owner=owner, task_id=task_id)
+
+    def hex(self) -> str:
+        return self.id
+
+    def __hash__(self):
+        return self._hash
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and self.id == other.id
+
+    def __repr__(self):
+        return f"ObjectRef({self.id[:16]})"
+
+    def __reduce__(self):
+        return (ObjectRef, (self.id, self.owner, self.task_id))

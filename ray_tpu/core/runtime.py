@@ -1,0 +1,544 @@
+"""Local-mode runtime: tasks, actors, and objects in one process.
+
+This is the core-worker-equivalent (reference: src/ray/core_worker/
+core_worker.cc — SubmitTask/ExecuteTask/Get/Put) for a single node: worker
+threads instead of worker processes, the in-process MemoryStore as the object
+store, and the *real* batched scheduling kernel in the loop — the same
+policy/kernel path the multi-node control plane uses, so scheduling semantics
+don't fork between modes.
+
+Threading model: a scheduler thread runs batched rounds (reference hot loop:
+ClusterTaskManager::ScheduleAndDispatchTasks, cluster_task_manager.cc);
+execution runs on a thread pool gated by resource accounting, not pool size;
+each actor gets a dedicated mailbox thread (per-caller FIFO ordering —
+reference: actor_submit_queue.h). Workers that block in get() release their
+resources while blocked (reference: CoreWorker::NotifyDirectCallTaskBlocked).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import traceback
+from collections import defaultdict, deque
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.core.config import Config
+from ray_tpu.core.exceptions import (
+    ActorDiedError,
+    TaskError,
+)
+from ray_tpu.core.memory_store import MemoryStore
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.task_spec import TaskSpec, new_id
+from ray_tpu.sched.policy import make_policy
+from ray_tpu.sched.resources import NodeResourceState, ResourceSpace
+
+_context = threading.local()
+
+
+class _ActorState:
+    def __init__(self, actor_id: str, node_idx: int, demand: np.ndarray):
+        self.actor_id = actor_id
+        self.node_idx = node_idx
+        self.demand = demand
+        self.mailbox: deque = deque()
+        self.cv = threading.Condition()
+        self.instance = None
+        self.dead = False
+        self.death_cause: Optional[str] = None
+        self.thread: Optional[threading.Thread] = None
+        self.num_restarts = 0
+
+
+class LocalRuntime:
+    """One-process cluster: single scheduling node, thread workers."""
+
+    def __init__(
+        self,
+        num_cpus: Optional[int] = None,
+        resources: Optional[Dict[str, float]] = None,
+        config: Optional[Config] = None,
+    ):
+        self.config = config or Config()
+        self.node_id = new_id("node")
+        self.worker_id = new_id("driver")
+        num_cpus = num_cpus if num_cpus is not None else (os.cpu_count() or 4)
+        res = {"CPU": float(num_cpus), "memory": float(2**33)}
+        res.update(resources or {})
+        self.space = ResourceSpace()
+        self.state = NodeResourceState(space=self.space)
+        self.state.add_node(self.node_id, res)
+        self.store = MemoryStore()
+        self.policy = make_policy(self.config.scheduling_policy)
+
+        self._lock = threading.Lock()
+        self._pending: deque = deque()  # schedulable TaskSpecs
+        self._waiting: Dict[str, Tuple[TaskSpec, set]] = {}  # task_id -> (spec, missing oids)
+        self._dep_index: Dict[str, List[str]] = defaultdict(list)  # oid -> task_ids
+        self._infeasible: deque = deque()
+        self._running: Dict[str, TaskSpec] = {}
+        self._actors: Dict[str, _ActorState] = {}
+        self._task_events: List[dict] = []  # timeline (ray timeline equivalent)
+
+        self._sched_cv = threading.Condition()
+        self._stopped = False
+        self._executor = ThreadPoolExecutor(
+            max_workers=max(int(num_cpus) * 4, 16), thread_name_prefix="raytpu-worker"
+        )
+        self._sched_thread = threading.Thread(
+            target=self._scheduler_loop, name="raytpu-sched", daemon=True
+        )
+        self._sched_thread.start()
+
+    # ------------------------------------------------------------------ submit
+
+    def submit_task(self, spec: TaskSpec) -> List[ObjectRef]:
+        refs = [
+            ObjectRef.for_task_output(spec.task_id, i, owner=self.worker_id)
+            for i in range(spec.num_returns)
+        ]
+        if spec.actor_creation:
+            # Register the mailbox immediately so method calls submitted
+            # before the creation task is scheduled queue up instead of
+            # failing (reference: the GCS actor table exists from
+            # registration, gcs_actor_manager.cc).
+            with self._lock:
+                self._actors[spec.actor_id] = _ActorState(spec.actor_id, 0, None)
+        ready = False
+        with self._lock:
+            missing = {
+                a.id
+                for a in list(spec.args) + list(spec.kwargs.values())
+                if isinstance(a, ObjectRef) and not self.store.contains(a)
+            }
+            if missing:
+                self._waiting[spec.task_id] = (spec, missing)
+                for oid in missing:
+                    self._dep_index[oid].append(spec.task_id)
+            else:
+                ready = True
+        if ready:
+            self._make_ready(spec)
+        else:
+            # Close the submit/complete race: a dependency may have landed
+            # between the contains() check and registration above — re-check
+            # and fire the ready path for anything now present.
+            for oid in list(missing):
+                if self.store.contains(ObjectRef(oid)):
+                    self._on_object_ready(ObjectRef(oid))
+        self._kick()
+        return refs
+
+    def _make_ready(self, spec: TaskSpec):
+        """Route a dependency-ready task: actor method calls bypass the
+        scheduler and go straight to the actor's mailbox (reference: actor
+        calls skip the raylet, actor_task_submitter.cc); everything else
+        queues for the batched scheduling round."""
+        if spec.actor_id is not None and not spec.actor_creation:
+            with self._lock:
+                self._running[spec.task_id] = spec
+            self._enqueue_actor_task(spec)
+        else:
+            with self._lock:
+                self._pending.append(spec)
+
+    def _kick(self):
+        with self._sched_cv:
+            self._sched_cv.notify()
+
+    def _on_object_ready(self, ref: ObjectRef):
+        newly_ready = []
+        with self._lock:
+            for tid in self._dep_index.pop(ref.id, []):
+                entry = self._waiting.get(tid)
+                if entry is None:
+                    continue
+                spec, missing = entry
+                missing.discard(ref.id)
+                if not missing:
+                    del self._waiting[tid]
+                    newly_ready.append(spec)
+        for spec in newly_ready:
+            self._make_ready(spec)
+        if newly_ready:
+            self._kick()
+
+    # --------------------------------------------------------------- scheduler
+
+    def _scheduler_loop(self):
+        interval = self.config.scheduler_round_interval_ms / 1000.0
+        while not self._stopped:
+            with self._sched_cv:
+                self._sched_cv.wait(timeout=interval)
+            try:
+                self._schedule_round()
+            except Exception:  # pragma: no cover - keep the loop alive
+                traceback.print_exc()
+
+    def _schedule_round(self):
+        """One batched round: group pending by scheduling class, run the
+        policy kernel, dispatch. Reference: ScheduleAndDispatchTasks."""
+        with self._lock:
+            if not self._pending and not self._infeasible:
+                return
+            batch = list(self._pending) + list(self._infeasible)
+            self._pending.clear()
+            self._infeasible.clear()
+
+        classes: Dict[Tuple, List[TaskSpec]] = defaultdict(list)
+        for spec in batch:
+            classes[spec.scheduling_class()].append(spec)
+        keys = list(classes.keys())
+        demands = np.stack(
+            [self.space.vector(classes[k][0].resources) for k in keys]
+        )
+        counts = np.array([len(classes[k]) for k in keys], dtype=np.int32)
+
+        with self._lock:
+            assigned = self.policy.schedule(self.state, demands, counts)
+
+        for c, key in enumerate(keys):
+            specs = classes[key]
+            placed = int(assigned[c].sum())
+            for spec, _ in zip(specs, range(placed)):
+                node_idx = 0  # single node in local mode
+                self._dispatch(spec, node_idx, demands[c])
+            for spec in specs[placed:]:
+                with self._lock:
+                    self._infeasible.append(spec)
+
+    def _dispatch(self, spec: TaskSpec, node_idx: int, demand: np.ndarray):
+        with self._lock:
+            self._running[spec.task_id] = spec
+        if spec.actor_creation:
+            self._start_actor(spec, node_idx, demand)
+        else:
+            self._executor.submit(self._run_task, spec, node_idx, demand)
+
+    # --------------------------------------------------------------- execution
+
+    def _resolve_args(self, spec: TaskSpec):
+        entries = {}
+        for a in list(spec.args) + list(spec.kwargs.values()):
+            if isinstance(a, ObjectRef):
+                e = self.store.try_get(a)
+                if e is None:
+                    raise RuntimeError(f"dependency {a} not ready at dispatch")
+                if e.is_exception:
+                    raise e.value if isinstance(e.value, BaseException) else TaskError(str(e.value))
+                entries[a.id] = e.value
+        args = tuple(entries[a.id] if isinstance(a, ObjectRef) else a for a in spec.args)
+        kwargs = {
+            k: (entries[v.id] if isinstance(v, ObjectRef) else v)
+            for k, v in spec.kwargs.items()
+        }
+        return args, kwargs
+
+    def _store_results(self, spec: TaskSpec, value: Any):
+        refs = [
+            ObjectRef.for_task_output(spec.task_id, i, owner=self.worker_id)
+            for i in range(spec.num_returns)
+        ]
+        if spec.num_returns == 1:
+            values = [value]
+        else:
+            values = list(value)
+            if len(values) != spec.num_returns:
+                raise ValueError(
+                    f"task {spec.name} returned {len(values)} values, "
+                    f"expected num_returns={spec.num_returns}"
+                )
+        for ref, v in zip(refs, values):
+            self.put_ref(ref, v)
+
+    def _store_error(self, spec: TaskSpec, err: BaseException):
+        for i in range(spec.num_returns):
+            ref = ObjectRef.for_task_output(spec.task_id, i, owner=self.worker_id)
+            self.put_ref(ref, err, is_exception=True)
+
+    def _run_task(self, spec: TaskSpec, node_idx: int, demand: np.ndarray):
+        _context.task = spec
+        _context.node_idx = node_idx
+        _context.demand = demand
+        _context.blocked_released = False
+        start = time.time()
+        try:
+            args, kwargs = self._resolve_args(spec)
+            value = spec.func(*args, **kwargs)
+            self._store_results(spec, value)
+            status = "FINISHED"
+        except BaseException as e:
+            if spec.retries_left > 0 and not isinstance(e, TaskError):
+                spec.retries_left -= 1
+                with self._lock:
+                    self._running.pop(spec.task_id, None)
+                    self._pending.append(spec)
+                self._release_resources(node_idx, demand)
+                self._kick()
+                _context.task = None
+                return
+            tb = traceback.format_exc()
+            self._store_error(
+                spec, TaskError(f"task {spec.name or spec.task_id} failed: {e!r}", tb)
+            )
+            status = "FAILED"
+        finally:
+            _context.task = None
+        with self._lock:
+            self._running.pop(spec.task_id, None)
+        if not getattr(_context, "blocked_released", False):
+            self._release_resources(node_idx, demand)
+        self._task_events.append(
+            {
+                "task_id": spec.task_id,
+                "name": spec.name,
+                "start": start,
+                "end": time.time(),
+                "status": status,
+                "node": self.node_id,
+            }
+        )
+        self._kick()
+
+    # ------------------------------------------------------------------ actors
+
+    def _release_resources(self, node_idx: int, demand) -> None:
+        """All resource mutations serialize on self._lock with the scheduler's
+        copy-compute-replace round, else releases landing mid-round are lost."""
+        if demand is None:
+            return
+        with self._lock:
+            self.state.release(node_idx, demand)
+
+    def _fail_actor(self, st: _ActorState, creation_spec: Optional[TaskSpec]):
+        """Resolve every ref tied to a dead actor so no caller hangs: the
+        creation ref (if the ctor never ran/finished) and all queued calls."""
+        err = ActorDiedError(
+            f"actor {st.actor_id} is dead: {st.death_cause or 'killed'}"
+        )
+        if creation_spec is not None:
+            self._store_error(creation_spec, err)
+            with self._lock:
+                self._running.pop(creation_spec.task_id, None)
+        with st.cv:
+            pending = list(st.mailbox)
+            st.mailbox.clear()
+        for spec in pending:
+            self._store_error(spec, err)
+            with self._lock:
+                self._running.pop(spec.task_id, None)
+
+    def _start_actor(self, spec: TaskSpec, node_idx: int, demand: np.ndarray):
+        with self._lock:
+            st = self._actors.get(spec.actor_id)
+            if st is None:
+                st = _ActorState(spec.actor_id, node_idx, demand)
+                self._actors[spec.actor_id] = st
+            else:
+                st.node_idx = node_idx
+                st.demand = demand
+        if st.dead:  # killed before creation ran
+            self._release_resources(node_idx, demand)
+            self._fail_actor(st, creation_spec=spec)
+            return
+        st.thread = threading.Thread(
+            target=self._actor_loop, args=(st, spec), daemon=True,
+            name=f"raytpu-actor-{spec.actor_id[:8]}",
+        )
+        st.thread.start()
+
+    def _actor_loop(self, st: _ActorState, creation_spec: TaskSpec):
+        _context.actor_id = st.actor_id
+        try:
+            args, kwargs = self._resolve_args(creation_spec)
+            cls = creation_spec.func
+            st.instance = cls(*args, **kwargs)
+            self._store_results(creation_spec, st.actor_id)
+        except BaseException as e:
+            tb = traceback.format_exc()
+            st.dead = True
+            st.death_cause = tb
+            self._store_error(
+                creation_spec,
+                ActorDiedError(f"actor constructor failed: {e!r}\n{tb}"),
+            )
+            self._release_resources(st.node_idx, st.demand)
+            self._fail_actor(st, creation_spec=None)
+            return
+        finally:
+            with self._lock:
+                self._running.pop(creation_spec.task_id, None)
+
+        while True:
+            with st.cv:
+                while not st.mailbox and not st.dead:
+                    st.cv.wait(timeout=0.5)
+                    if self._stopped:
+                        return
+                if st.dead:
+                    break
+                spec = st.mailbox.popleft()
+            start = time.time()
+            try:
+                args, kwargs = self._resolve_args(spec)
+                method = getattr(st.instance, spec.method_name)
+                value = method(*args, **kwargs)
+                self._store_results(spec, value)
+                status = "FINISHED"
+            except BaseException as e:
+                tb = traceback.format_exc()
+                self._store_error(
+                    spec, TaskError(f"actor method {spec.method_name} failed: {e!r}", tb)
+                )
+                status = "FAILED"
+            with self._lock:
+                self._running.pop(spec.task_id, None)
+            self._task_events.append(
+                {
+                    "task_id": spec.task_id,
+                    "name": spec.name,
+                    "start": start,
+                    "end": time.time(),
+                    "status": status,
+                    "node": self.node_id,
+                    "actor_id": st.actor_id,
+                }
+            )
+        # drain mailbox with death errors
+        self._fail_actor(st, creation_spec=None)
+        self._release_resources(st.node_idx, st.demand)
+
+    def _enqueue_actor_task(self, spec: TaskSpec):
+        # Actor method calls consume no scheduler resources; the actor holds
+        # its allocation for its lifetime (reference: actor tasks bypass the
+        # raylet and go straight to the actor's worker, actor_task_submitter.cc).
+        st = self._actors.get(spec.actor_id)
+        if st is not None:
+            with st.cv:
+                if not st.dead:
+                    st.mailbox.append(spec)
+                    st.cv.notify()
+                    return
+        cause = st.death_cause if st else "unknown actor"
+        self._store_error(spec, ActorDiedError(f"actor {spec.actor_id} is dead: {cause}"))
+        with self._lock:
+            self._running.pop(spec.task_id, None)
+
+    def submit_actor_task(self, spec: TaskSpec) -> List[ObjectRef]:
+        return self.submit_task(spec)
+
+    def kill_actor(self, actor_id: str, no_restart: bool = True):
+        st = self._actors.get(actor_id)
+        if st is None:
+            return
+        with st.cv:
+            st.dead = True
+            st.death_cause = "ray_tpu.kill() called"
+            st.cv.notify()
+
+    # ----------------------------------------------------------------- objects
+
+    def put(self, value: Any) -> ObjectRef:
+        ref = ObjectRef(owner=self.worker_id)
+        self.put_ref(ref, value)
+        return ref
+
+    def put_ref(self, ref: ObjectRef, value: Any, is_exception: bool = False):
+        self.store.put(ref, value, is_exception)
+        self._on_object_ready(ref)
+
+    def get(self, refs: List[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
+        self._release_while_blocked(True)
+        try:
+            entries = self.store.get(refs, timeout)
+        finally:
+            self._release_while_blocked(False)
+        out = []
+        for e in entries:
+            if e.is_exception:
+                raise e.value if isinstance(e.value, BaseException) else TaskError(str(e.value))
+            out.append(e.value)
+        return out
+
+    def wait(self, refs, num_returns=1, timeout=None):
+        self._release_while_blocked(True)
+        try:
+            return self.store.wait(refs, num_returns, timeout)
+        finally:
+            self._release_while_blocked(False)
+
+    def _release_while_blocked(self, entering: bool):
+        """A worker blocking in get() releases its CPUs so siblings can run
+        (reference: CoreWorker::NotifyDirectCallTaskBlocked / Unblocked)."""
+        spec = getattr(_context, "task", None)
+        if spec is None:
+            return
+        demand = getattr(_context, "demand", None)
+        node_idx = getattr(_context, "node_idx", 0)
+        if demand is None:
+            return
+        if entering:
+            self._release_resources(node_idx, demand)
+            _context.blocked_released = True
+            self._kick()
+        else:
+            # Reacquire without feasibility check: temporary oversubscription
+            # beats deadlock (same tradeoff the reference makes).
+            with self._lock:
+                self.state.available[node_idx] -= demand
+            _context.blocked_released = False
+
+    def free(self, refs: List[ObjectRef]):
+        self.store.delete(refs)
+
+    # ------------------------------------------------------------------- misc
+
+    def cluster_resources(self) -> Dict[str, float]:
+        agg: Dict[str, float] = defaultdict(float)
+        for m in self.state.total_map().values():
+            for k, v in m.items():
+                agg[k] += v
+        return dict(agg)
+
+    def available_resources(self) -> Dict[str, float]:
+        agg: Dict[str, float] = defaultdict(float)
+        for m in self.state.available_map().values():
+            for k, v in m.items():
+                agg[k] += v
+        return dict(agg)
+
+    def nodes(self) -> List[dict]:
+        return [
+            {
+                "NodeID": nid,
+                "Alive": bool(self.state.alive[i]),
+                "Resources": self.space.unvector(self.state.total[i]),
+            }
+            for i, nid in enumerate(self.state.node_ids)
+        ]
+
+    def timeline(self) -> List[dict]:
+        return list(self._task_events)
+
+    def current_task_id(self) -> Optional[str]:
+        spec = getattr(_context, "task", None)
+        return spec.task_id if spec else None
+
+    def current_actor_id(self) -> Optional[str]:
+        return getattr(_context, "actor_id", None)
+
+    def shutdown(self):
+        self._stopped = True
+        self._kick()
+        for st in list(self._actors.values()):
+            with st.cv:
+                st.dead = True
+                st.cv.notify()
+        self._executor.shutdown(wait=False, cancel_futures=True)
+        if self._sched_thread.is_alive():
+            self._sched_thread.join(timeout=2)

@@ -1,0 +1,15 @@
+from ray_tpu.models.transformer import (
+    TransformerConfig,
+    init_params,
+    forward,
+    loss_fn,
+    param_partition_specs,
+)
+
+__all__ = [
+    "TransformerConfig",
+    "init_params",
+    "forward",
+    "loss_fn",
+    "param_partition_specs",
+]

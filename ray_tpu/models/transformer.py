@@ -1,0 +1,174 @@
+"""Flagship model: decoder-only transformer, TPU-first.
+
+Pure-JAX (no flax dependency in the hot path): params are a pytree of
+jnp arrays, the forward pass is a single jittable function, and tensor
+parallelism is expressed as `PartitionSpec`s over a ("dp", "tp") mesh —
+XLA SPMD inserts the collectives (the TPU-native answer to the reference's
+NCCL process groups in python/ray/train/torch/train_loop_utils.py).
+
+Design notes for the MXU:
+- all matmuls are [B*S, D] x [D, F] shaped, bfloat16 activations/float32
+  accumulation (preferred_element_type), static shapes;
+- attention uses one fused einsum per projection; no Python loops over heads;
+- the TP sharding follows Megatron layout: QKV/ffn-in column-parallel,
+  proj/ffn-out row-parallel, so each layer needs exactly one all-reduce
+  (psum) on the residual add — which XLA inserts from the shardings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32768
+    d_model: int = 512
+    n_heads: int = 8
+    n_layers: int = 6
+    d_ff: int = 2048
+    max_seq_len: int = 2048
+    dtype: Any = jnp.bfloat16
+    rope_theta: float = 10000.0
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def init_params(key, cfg: TransformerConfig) -> Dict:
+    """Initialize a params pytree. Layers are stacked along a leading axis so
+    the forward pass is a lax.scan (one compiled layer body, XLA-friendly)."""
+    k_emb, k_out, k_layers = jax.random.split(key, 3)
+    L, D, F, H = cfg.n_layers, cfg.d_model, cfg.d_ff, cfg.n_heads
+
+    def norm_init(k, shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32) / math.sqrt(fan_in)).astype(
+            jnp.float32
+        )
+
+    ks = jax.random.split(k_layers, 6 * L).reshape(L, 6, 2)
+    layers = {
+        "wqkv": jnp.stack(
+            [norm_init(ks[l, 0], (D, 3 * D), D) for l in range(L)]
+        ),
+        "wo": jnp.stack([norm_init(ks[l, 1], (D, D), D) for l in range(L)]),
+        "w1": jnp.stack([norm_init(ks[l, 2], (D, F), D) for l in range(L)]),
+        "w2": jnp.stack([norm_init(ks[l, 3], (F, D), F) for l in range(L)]),
+        "ln1": jnp.ones((L, D), jnp.float32),
+        "ln2": jnp.ones((L, D), jnp.float32),
+    }
+    return {
+        "embed": norm_init(k_emb, (cfg.vocab_size, D), D),
+        "unembed": norm_init(k_out, (D, cfg.vocab_size), D),
+        "ln_f": jnp.ones((D,), jnp.float32),
+        "layers": layers,
+    }
+
+
+def param_partition_specs(cfg: TransformerConfig) -> Dict:
+    """Megatron-style TP layout over mesh axis "tp" (fsdp composes by also
+    shard-mapping the other param axis over "dp" — see parallel.trainer)."""
+    return {
+        "embed": P(None, "tp"),
+        "unembed": P("tp", None),
+        "ln_f": P(None),
+        "layers": {
+            "wqkv": P(None, None, "tp"),   # column parallel
+            "wo": P(None, "tp", None),     # row parallel
+            "w1": P(None, None, "tp"),
+            "w2": P(None, "tp", None),
+            "ln1": P(None, None),
+            "ln2": P(None, None),
+        },
+    }
+
+
+def _rmsnorm(x, scale):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * scale.astype(x.dtype)
+
+
+def _rope(x, theta: float):
+    """Rotary position embedding over the last dim. x: [B, S, H, Dh]."""
+    _, S, _, Dh = x.shape
+    half = Dh // 2
+    freqs = jnp.exp(
+        -math.log(theta) * jnp.arange(0, half, dtype=jnp.float32) / half
+    )
+    pos = jnp.arange(S, dtype=jnp.float32)
+    angles = pos[:, None] * freqs[None, :]  # [S, half]
+    cos = jnp.cos(angles)[None, :, None, :].astype(x.dtype)
+    sin = jnp.sin(angles)[None, :, None, :].astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+
+
+def _attention(q, k, v, cfg: TransformerConfig):
+    """Causal attention. q,k,v: [B, S, H, Dh]. One einsum per contraction so
+    XLA maps them onto the MXU; causal mask is a static iota comparison."""
+    B, S, H, Dh = q.shape
+    scale = 1.0 / math.sqrt(Dh)
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
+    logits = jnp.where(mask[None, None, :, :], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _layer(x, layer_params, cfg: TransformerConfig):
+    """One transformer block. x: [B, S, D]."""
+    B, S, D = x.shape
+    H, Dh = cfg.n_heads, cfg.head_dim
+    h = _rmsnorm(x, layer_params["ln1"])
+    qkv = jnp.einsum(
+        "bsd,de->bse", h, layer_params["wqkv"].astype(cfg.dtype)
+    )
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = _rope(q.reshape(B, S, H, Dh), cfg.rope_theta)
+    k = _rope(k.reshape(B, S, H, Dh), cfg.rope_theta)
+    v = v.reshape(B, S, H, Dh)
+    attn = _attention(q, k, v, cfg).reshape(B, S, D)
+    x = x + jnp.einsum("bsd,de->bse", attn, layer_params["wo"].astype(cfg.dtype))
+    h = _rmsnorm(x, layer_params["ln2"])
+    ff = jnp.einsum("bsd,df->bsf", h, layer_params["w1"].astype(cfg.dtype))
+    ff = jax.nn.gelu(ff)
+    x = x + jnp.einsum("bsf,fd->bsd", ff, layer_params["w2"].astype(cfg.dtype))
+    return x
+
+
+def forward(params: Dict, tokens: jnp.ndarray, cfg: TransformerConfig) -> jnp.ndarray:
+    """tokens [B, S] int32 -> logits [B, S, V]. Layers run under lax.scan with
+    jax.checkpoint (remat) so HBM holds one layer's activations, trading
+    FLOPs for memory the TPU way."""
+    x = params["embed"].astype(cfg.dtype)[tokens]
+
+    @jax.checkpoint
+    def body(carry, layer_params):
+        return _layer(carry, layer_params, cfg), None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = _rmsnorm(x, params["ln_f"])
+    logits = jnp.einsum(
+        "bsd,dv->bsv", x, params["unembed"].astype(cfg.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    return logits
+
+
+def loss_fn(params: Dict, batch: Dict, cfg: TransformerConfig) -> jnp.ndarray:
+    """Next-token cross-entropy. batch: {"tokens": [B, S]}."""
+    tokens = batch["tokens"]
+    logits = forward(params, tokens[:, :-1], cfg)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -ll.mean()

@@ -1,0 +1,3 @@
+from ray_tpu.parallel.mesh import make_mesh, mesh_shape_for
+
+__all__ = ["make_mesh", "mesh_shape_for"]

@@ -1,0 +1,58 @@
+"""Device-mesh construction and axis conventions.
+
+The framework's collective layer is the XLA compiler: shardings over a
+`jax.sharding.Mesh` make XLA insert psum/all-gather/ppermute on ICI — the
+TPU-native replacement for the reference's NCCL groups
+(python/ray/util/collective/collective_group/nccl_collective_group.py).
+
+Axis conventions used across the repo:
+  "dp"  — data parallel (batch dim; gradients psum here)
+  "tp"  — tensor parallel (Megatron column/row layout in models/)
+  "sp"  — sequence/context parallel (ring attention in parallel/ring_attention)
+  "pp"  — pipeline stages (parallel/pipeline)
+  "ep"  — expert parallel (models/moe)
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def mesh_shape_for(n: int, axes: Sequence[str]) -> Tuple[int, ...]:
+    """Factor n devices into a mesh shape, biggest factors to the *last*
+    (innermost/fastest-ICI) axes: tp wants the tightest links."""
+    shape = [1] * len(axes)
+    remaining = n
+    for i in range(len(axes) - 1, 0, -1):
+        f = _largest_factor_leq(remaining, int(np.sqrt(remaining)) + 1)
+        shape[i] = f
+        remaining //= f
+    shape[0] = remaining
+    return tuple(shape)
+
+
+def _largest_factor_leq(n: int, cap: int) -> int:
+    best = 1
+    for f in range(1, cap + 1):
+        if n % f == 0:
+            best = f
+    return best
+
+
+def make_mesh(
+    axes: Sequence[str] = ("dp", "tp"),
+    shape: Optional[Sequence[int]] = None,
+    devices=None,
+) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if shape is None:
+        shape = mesh_shape_for(n, axes)
+    if int(np.prod(shape)) != n:
+        raise ValueError(f"mesh shape {shape} != {n} devices")
+    dev_array = np.array(devices).reshape(shape)
+    return Mesh(dev_array, tuple(axes))

@@ -1,0 +1,151 @@
+"""Pluggable scheduling policies over the batched kernels.
+
+Reference: src/ray/raylet/scheduling/policy/scheduling_policy.h defines
+ISchedulingPolicy::Schedule dispatched by composite_scheduling_policy.cc; the
+per-request policy set is hybrid/spread/random/node-affinity/node-label.
+Here a policy consumes the whole pending queue (grouped into scheduling
+classes) per round instead of one request, and selects the compute backend:
+``numpy`` (CPU fallback) or ``jax`` (TPU) — the `policy="jax_tpu"` hook from
+BASELINE.json's north star.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.sched import kernel_np
+from ray_tpu.sched.resources import NodeResourceState
+
+
+class SchedulingPolicy:
+    """Schedule per-class pending counts onto nodes.
+
+    schedule() returns assigned[C, N] int32; under-assignment means the
+    remainder is currently infeasible and stays queued (reference:
+    cluster_task_manager.cc infeasible/waiting queues).
+    """
+
+    name = "base"
+
+    def schedule(
+        self, state: NodeResourceState, demands: np.ndarray, counts: np.ndarray
+    ) -> np.ndarray:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class HybridPolicy(SchedulingPolicy):
+    """Default policy: pack-until-threshold then spread (reference:
+    hybrid_scheduling_policy.cc). backend="jax" keeps the cluster view
+    device-resident via kernel_jax.JaxScheduler."""
+
+    def __init__(self, spread_threshold: float = 0.5, backend: str = "numpy"):
+        self.spread_threshold = spread_threshold
+        self.backend = backend
+        self._jax = None  # lazily built JaxScheduler (topology-dependent)
+        self._topology_key = None
+
+    @property
+    def name(self):
+        return "hybrid" if self.backend == "numpy" else "jax_tpu"
+
+    def _jax_sched(self, state: NodeResourceState):
+        from ray_tpu.sched.kernel_jax import JaxScheduler
+
+        key = (len(state.node_ids), state.total.tobytes(), state.alive.tobytes())
+        if self._jax is None or self._topology_key != key:
+            self._jax = JaxScheduler(state.total, state.alive)
+            self._topology_key = key
+        self._jax.set_available(state.available)
+        return self._jax
+
+    def schedule(self, state, demands, counts):
+        if self.backend == "jax":
+            sched = self._jax_sched(state)
+            assigned = sched.schedule(demands, counts, self.spread_threshold)
+            # keep the host view authoritative (device copy is a cache)
+            taken = assigned.astype(np.float32).T @ demands  # [N, R]
+            state.available = np.maximum(state.available - taken, 0.0)
+            return assigned
+        assigned, new_avail = kernel_np.schedule_classes(
+            state.available, state.total, state.alive, demands, counts,
+            spread_threshold=self.spread_threshold,
+        )
+        state.available = new_avail
+        return assigned
+
+
+class SpreadPolicy(SchedulingPolicy):
+    """Round-robin over feasible nodes (reference: spread_scheduling_policy.cc)."""
+
+    name = "spread"
+
+    def __init__(self):
+        self._cursor = 0
+
+    def schedule(self, state, demands, counts):
+        C = demands.shape[0]
+        N = len(state)
+        assigned = np.zeros((C, N), dtype=np.int32)
+        for c in range(C):
+            expand = np.repeat(demands[c][None, :], int(counts[c]), axis=0)
+            nodes, new_avail = kernel_np.spread_assign(
+                state.available, state.total, state.alive, expand, start=self._cursor
+            )
+            state.available = new_avail
+            placed = nodes[nodes >= 0]
+            if len(placed):
+                np.add.at(assigned[c], placed, 1)
+                self._cursor = (int(placed[-1]) + 1) % max(N, 1)
+        return assigned
+
+
+class NodeAffinityPolicy(SchedulingPolicy):
+    """Pin to a specific node, optionally soft (reference:
+    node_affinity_scheduling_policy.cc)."""
+
+    name = "node_affinity"
+
+    def __init__(self, node_id: str, soft: bool = False, fallback: Optional[SchedulingPolicy] = None):
+        self.node_id = node_id
+        self.soft = soft
+        self.fallback = fallback or HybridPolicy()
+
+    def schedule(self, state, demands, counts):
+        idx = state.node_index(self.node_id)
+        C, N = demands.shape[0], len(state)
+        assigned = np.zeros((C, N), dtype=np.int32)
+        leftover = counts.copy()
+        if idx is not None and state.alive[idx]:
+            for c in range(C):
+                fit = kernel_np._class_fit(
+                    state.available, state.alive, demands[c]
+                )[idx]
+                take = int(min(fit, leftover[c]))
+                if take > 0:
+                    assigned[c, idx] = take
+                    state.available[idx] = np.maximum(
+                        state.available[idx] - take * demands[c], 0.0
+                    )
+                    leftover[c] -= take
+        if self.soft and leftover.any():
+            assigned += self.fallback.schedule(state, demands, leftover)
+        return assigned
+
+
+_POLICIES = {
+    "hybrid": lambda **kw: HybridPolicy(backend="numpy", **kw),
+    "jax_tpu": lambda **kw: HybridPolicy(backend="jax", **kw),
+    "spread": lambda **kw: SpreadPolicy(),
+}
+
+
+def make_policy(name: str, **kwargs) -> SchedulingPolicy:
+    try:
+        return _POLICIES[name](**kwargs)
+    except KeyError:
+        raise ValueError(f"unknown scheduling policy {name!r}; have {list(_POLICIES)}")

@@ -1,0 +1,217 @@
+"""Vectorized resource model: struct-of-arrays cluster resource views.
+
+Reference equivalents:
+- NodeResources / ResourceRequest: src/ray/common/scheduling/cluster_resource_data.h
+- string->int resource-ID interning: src/ray/common/scheduling/scheduling_ids.h
+
+The reference stores per-node resource maps and iterates them per scheduling
+decision. Here the cluster view is a pair of float32 matrices
+``total[N, R]`` / ``available[N, R]`` with resource names interned to fixed
+column indices, so feasibility and scoring are elementwise array ops that lower
+to the TPU VPU/MXU without reshapes.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Mapping, Optional, Sequence
+
+import numpy as np
+
+# Predefined resource columns, mirroring the reference's PredefinedResources
+# enum (src/ray/common/scheduling/scheduling_ids.h: CPU/MEM/GPU/OBJECT_STORE_MEM).
+# "TPU" is first-class here, where the reference models accelerators as "GPU"
+# plus accelerator-type custom resources.
+PREDEFINED_RESOURCES: tuple = ("CPU", "GPU", "TPU", "memory", "object_store_memory")
+
+# Feasibility tolerance: resource quantities in the reference are fixed-point
+# (FixedPoint, 1e-4 granularity); we use float32 + epsilon.
+EPS = 1e-4
+
+
+class ResourceSpace:
+    """Interns resource names to column indices in a fixed-width float32 space.
+
+    The width is padded up front (default 16 columns) so adding a custom
+    resource never changes array shapes under jit — mirroring the reference's
+    int-interned resource IDs (scheduling_ids.h) but with a static bound, which
+    is what XLA needs for stable compiled shapes.
+    """
+
+    def __init__(self, max_resources: int = 16):
+        if max_resources < len(PREDEFINED_RESOURCES):
+            raise ValueError("max_resources must cover predefined resources")
+        self.max_resources = max_resources
+        self._name_to_idx: Dict[str, int] = {
+            name: i for i, name in enumerate(PREDEFINED_RESOURCES)
+        }
+        self._idx_to_name: List[str] = list(PREDEFINED_RESOURCES)
+        self._lock = threading.Lock()
+
+    @property
+    def names(self) -> List[str]:
+        return list(self._idx_to_name)
+
+    def intern(self, name: str) -> int:
+        with self._lock:
+            idx = self._name_to_idx.get(name)
+            if idx is None:
+                idx = len(self._idx_to_name)
+                if idx >= self.max_resources:
+                    raise ValueError(
+                        f"resource space exhausted ({self.max_resources} columns); "
+                        f"raise max_resources"
+                    )
+                self._name_to_idx[name] = idx
+                self._idx_to_name.append(name)
+            return idx
+
+    def index(self, name: str) -> Optional[int]:
+        return self._name_to_idx.get(name)
+
+    def vector(self, resources: Mapping[str, float]) -> np.ndarray:
+        """Pack a {name: amount} map into a padded float32 demand vector."""
+        v = np.zeros(self.max_resources, dtype=np.float32)
+        for name, amount in resources.items():
+            if amount == 0:
+                continue
+            v[self.intern(name)] = float(amount)
+        return v
+
+    def unvector(self, vec: np.ndarray) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for i, val in enumerate(np.asarray(vec)):
+            if val != 0 and i < len(self._idx_to_name):
+                out[self._idx_to_name[i]] = float(val)
+        return out
+
+
+def pack_demands(
+    space: ResourceSpace, demands: Sequence[Mapping[str, float]]
+) -> np.ndarray:
+    """Pack a list of per-task resource maps into a [T, R] demand matrix."""
+    out = np.zeros((len(demands), space.max_resources), dtype=np.float32)
+    for t, d in enumerate(demands):
+        out[t] = space.vector(d)
+    return out
+
+
+@dataclass
+class NodeResourceState:
+    """Mutable cluster resource view: the scheduler's input matrices.
+
+    Reference: ClusterResourceManager's map of NodeResources
+    (src/ray/raylet/scheduling/cluster_resource_manager.cc), flattened to
+    struct-of-arrays. Row order is stable; node 0 is conventionally the local
+    node so "prefer local" tiebreaks fall out of stable argmin.
+    """
+
+    space: ResourceSpace
+    node_ids: List[str] = field(default_factory=list)
+    total: np.ndarray = None  # [N, R] float32
+    available: np.ndarray = None  # [N, R] float32
+    alive: np.ndarray = None  # [N] bool
+    labels: List[Dict[str, str]] = field(default_factory=list)
+
+    def __post_init__(self):
+        r = self.space.max_resources
+        if self.total is None:
+            self.total = np.zeros((0, r), dtype=np.float32)
+        if self.available is None:
+            self.available = np.zeros((0, r), dtype=np.float32)
+        if self.alive is None:
+            self.alive = np.zeros((0,), dtype=bool)
+        self._index: Dict[str, int] = {nid: i for i, nid in enumerate(self.node_ids)}
+
+    def __len__(self) -> int:
+        return len(self.node_ids)
+
+    def node_index(self, node_id: str) -> Optional[int]:
+        return self._index.get(node_id)
+
+    def add_node(
+        self,
+        node_id: str,
+        resources: Mapping[str, float],
+        labels: Optional[Dict[str, str]] = None,
+    ) -> int:
+        if node_id in self._index:
+            raise ValueError(f"duplicate node {node_id}")
+        vec = self.space.vector(resources)
+        self.total = np.vstack([self.total, vec[None, :]])
+        self.available = np.vstack([self.available, vec[None, :]])
+        self.alive = np.append(self.alive, True)
+        idx = len(self.node_ids)
+        self.node_ids.append(node_id)
+        self.labels.append(dict(labels or {}))
+        self._index[node_id] = idx
+        return idx
+
+    def remove_node(self, node_id: str) -> None:
+        idx = self._index.get(node_id)
+        if idx is None:
+            return
+        # Keep row (stable indices for in-flight decisions); mark dead and zero
+        # availability so the kernels mask it out — same effect as the
+        # reference erasing the node from the cluster view.
+        self.alive[idx] = False
+        self.available[idx] = 0.0
+        self.total[idx] = 0.0
+
+    def update_available(self, node_id: str, available: Mapping[str, float]) -> None:
+        """Overwrite a node's availability from a sync report (ray_syncer-style)."""
+        idx = self._index[node_id]
+        self.available[idx] = self.space.vector(available)
+
+    def allocate(self, node_idx: int, demand: np.ndarray) -> bool:
+        """Try to deduct `demand` from node `node_idx`. Returns False if it no
+        longer fits (the caller treats that as a failed lease → reschedule)."""
+        if not self.alive[node_idx]:
+            return False
+        if np.any(self.available[node_idx] + EPS < demand):
+            return False
+        self.available[node_idx] -= demand
+        np.maximum(self.available[node_idx], 0.0, out=self.available[node_idx])
+        return True
+
+    def release(self, node_idx: int, demand: np.ndarray) -> None:
+        if not self.alive[node_idx]:
+            return
+        self.available[node_idx] = np.minimum(
+            self.available[node_idx] + demand, self.total[node_idx]
+        )
+
+    def feasible_anywhere(self, demand: np.ndarray) -> bool:
+        """Is there any node whose *total* resources cover the demand?
+        (Reference: ClusterResourceScheduler::IsSchedulableOnNode on totals —
+        infeasible-forever vs just-currently-full.)"""
+        if len(self.node_ids) == 0:
+            return False
+        ok = np.all(self.total + EPS >= demand[None, :], axis=1) & self.alive
+        return bool(ok.any())
+
+    def snapshot(self) -> "NodeResourceState":
+        s = NodeResourceState(
+            space=self.space,
+            node_ids=list(self.node_ids),
+            total=self.total.copy(),
+            available=self.available.copy(),
+            alive=self.alive.copy(),
+            labels=[dict(l) for l in self.labels],
+        )
+        return s
+
+    def available_map(self) -> Dict[str, Dict[str, float]]:
+        return {
+            nid: self.space.unvector(self.available[i])
+            for i, nid in enumerate(self.node_ids)
+            if self.alive[i]
+        }
+
+    def total_map(self) -> Dict[str, Dict[str, float]]:
+        return {
+            nid: self.space.unvector(self.total[i])
+            for i, nid in enumerate(self.node_ids)
+            if self.alive[i]
+        }

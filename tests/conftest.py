@@ -1,0 +1,39 @@
+"""Test harness configuration.
+
+Multi-chip behavior is tested on a virtual 8-device CPU mesh
+(xla_force_host_platform_device_count), mirroring how the reference tests
+"multi-node" with many local processes holding declarative fake resources
+(python/ray/cluster_utils.py Cluster; SURVEY §4). Must run before jax import.
+"""
+
+import os
+import sys
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+# The axon sitecustomize registers the TPU PJRT plugin and sets
+# jax_platforms="axon,cpu" via jax.config at interpreter start, so the env
+# var alone is not enough — override through jax.config before any backend
+# initializes.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def local_ray():
+    """ray_start_regular-equivalent: a fresh local runtime per test."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=False)
+    yield ray_tpu
+    ray_tpu.shutdown()

@@ -1,0 +1,299 @@
+"""Core API tests: tasks, actors, objects (reference test analogs:
+python/ray/tests/test_basic.py, test_actor.py)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.exceptions import ActorDiedError, GetTimeoutError, TaskError
+
+
+def test_task_roundtrip(local_ray):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(1, 2)) == 3
+
+
+def test_task_with_object_args(local_ray):
+    @ray_tpu.remote
+    def double(x):
+        return 2 * x
+
+    x = ray_tpu.put(21)
+    assert ray_tpu.get(double.remote(x)) == 42
+
+
+def test_task_chaining_dependencies(local_ray):
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    ref = inc.remote(0)
+    for _ in range(9):
+        ref = inc.remote(ref)
+    assert ray_tpu.get(ref) == 10
+
+
+def test_num_returns(local_ray):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray_tpu.get([a, b, c]) == [1, 2, 3]
+
+
+def test_task_error_propagates(local_ray):
+    @ray_tpu.remote(max_retries=0)
+    def boom():
+        raise ValueError("boom")
+
+    with pytest.raises(TaskError, match="boom"):
+        ray_tpu.get(boom.remote())
+
+
+def test_error_propagates_through_chain(local_ray):
+    @ray_tpu.remote(max_retries=0)
+    def boom():
+        raise ValueError("chain-boom")
+
+    @ray_tpu.remote
+    def passthrough(x):
+        return x
+
+    with pytest.raises(TaskError, match="chain-boom"):
+        ray_tpu.get(passthrough.remote(boom.remote()))
+
+
+def test_get_timeout(local_ray):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(5)
+
+    with pytest.raises(GetTimeoutError):
+        ray_tpu.get(slow.remote(), timeout=0.1)
+
+
+def test_wait(local_ray):
+    @ray_tpu.remote
+    def sleepy(t):
+        time.sleep(t)
+        return t
+
+    fast = sleepy.remote(0.01)
+    slow = sleepy.remote(2.0)
+    ready, not_ready = ray_tpu.wait([fast, slow], num_returns=1, timeout=1.0)
+    assert ready == [fast]
+    assert not_ready == [slow]
+
+
+def test_nested_tasks(local_ray):
+    @ray_tpu.remote
+    def outer():
+        @ray_tpu.remote
+        def inner(v):
+            return v * 2
+
+        return ray_tpu.get(inner.remote(5))
+
+    assert ray_tpu.get(outer.remote()) == 10
+
+
+def test_parallel_speedup(local_ray):
+    @ray_tpu.remote
+    def block(t):
+        time.sleep(t)
+        return 1
+
+    start = time.time()
+    refs = [block.remote(0.3) for _ in range(4)]
+    assert sum(ray_tpu.get(refs)) == 4
+    # 4 cpus -> should run concurrently, well under serial 1.2s
+    assert time.time() - start < 1.0
+
+
+def test_actor_basic(local_ray):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start=0):
+            self.v = start
+
+        def inc(self, k=1):
+            self.v += k
+            return self.v
+
+        def value(self):
+            return self.v
+
+    c = Counter.remote(10)
+    assert ray_tpu.get(c.inc.remote()) == 11
+    assert ray_tpu.get(c.inc.remote(5)) == 16
+    assert ray_tpu.get(c.value.remote()) == 16
+
+
+def test_actor_ordering(local_ray):
+    @ray_tpu.remote
+    class Appender:
+        def __init__(self):
+            self.items = []
+
+        def add(self, x):
+            self.items.append(x)
+            return list(self.items)
+
+    a = Appender.remote()
+    refs = [a.add.remote(i) for i in range(20)]
+    final = ray_tpu.get(refs[-1])
+    assert final == list(range(20))
+
+
+def test_actor_error_survival(local_ray):
+    @ray_tpu.remote
+    class Fragile:
+        def ok(self):
+            return "ok"
+
+        def fail(self):
+            raise RuntimeError("actor method failed")
+
+    f = Fragile.remote()
+    assert ray_tpu.get(f.ok.remote()) == "ok"
+    with pytest.raises(TaskError, match="actor method failed"):
+        ray_tpu.get(f.fail.remote())
+    # actor survives method errors
+    assert ray_tpu.get(f.ok.remote()) == "ok"
+
+
+def test_kill_actor(local_ray):
+    @ray_tpu.remote
+    class Victim:
+        def ping(self):
+            return "pong"
+
+    v = Victim.remote()
+    assert ray_tpu.get(v.ping.remote()) == "pong"
+    ray_tpu.kill(v)
+    time.sleep(0.2)
+    with pytest.raises(ActorDiedError):
+        ray_tpu.get(v.ping.remote(), timeout=2)
+
+
+def test_actor_handle_passing(local_ray):
+    @ray_tpu.remote
+    class Store:
+        def __init__(self):
+            self.v = 0
+
+        def set(self, v):
+            self.v = v
+
+        def get(self):
+            return self.v
+
+    @ray_tpu.remote
+    def writer(store, v):
+        ray_tpu.get(store.set.remote(v))
+        return True
+
+    s = Store.remote()
+    assert ray_tpu.get(writer.remote(s, 123))
+    assert ray_tpu.get(s.get.remote()) == 123
+
+
+def test_actor_ctor_failure_resolves_queued_calls(local_ray):
+    @ray_tpu.remote
+    class Broken:
+        def __init__(self):
+            raise RuntimeError("ctor boom")
+
+        def ping(self):
+            return "pong"
+
+    b = Broken.remote()
+    ref = b.ping.remote()  # enqueued before/while the ctor fails
+    with pytest.raises((ActorDiedError, TaskError)):
+        ray_tpu.get(ref, timeout=5)
+
+
+def test_kill_before_creation_resolves_refs(local_ray):
+    import threading
+
+    gate = threading.Event()
+
+    @ray_tpu.remote
+    class Slow:
+        def __init__(self):
+            gate.wait(timeout=5)
+
+        def ping(self):
+            return "pong"
+
+    s = Slow.remote()
+    ref = s.ping.remote()
+    ray_tpu.kill(s)
+    gate.set()
+    with pytest.raises(ActorDiedError):
+        ray_tpu.get(ref, timeout=5)
+
+
+def test_wait_num_returns_validation(local_ray):
+    r = ray_tpu.put(1)
+    with pytest.raises(ValueError, match="num_returns"):
+        ray_tpu.wait([r], num_returns=2)
+
+
+def test_retries(local_ray):
+    import threading
+
+    attempts = {"n": 0}
+    lock = threading.Lock()
+
+    @ray_tpu.remote(max_retries=3)
+    def flaky():
+        with lock:
+            attempts["n"] += 1
+            n = attempts["n"]
+        if n < 3:
+            raise OSError("transient")
+        return "recovered"
+
+    assert ray_tpu.get(flaky.remote()) == "recovered"
+    assert attempts["n"] == 3
+
+
+def test_cluster_resources(local_ray):
+    res = ray_tpu.cluster_resources()
+    assert res["CPU"] == 4.0
+    avail = ray_tpu.available_resources()
+    assert avail["CPU"] == 4.0
+
+
+def test_runtime_context(local_ray):
+    @ray_tpu.remote
+    def who():
+        ctx = ray_tpu.get_runtime_context()
+        return ctx.get_task_id()
+
+    tid = ray_tpu.get(who.remote())
+    assert tid and tid.startswith("task-")
+
+
+def test_options_override(local_ray):
+    @ray_tpu.remote(num_cpus=1)
+    def f():
+        return 1
+
+    assert ray_tpu.get(f.options(num_cpus=2).remote()) == 1
+
+
+def test_timeline_events(local_ray):
+    @ray_tpu.remote
+    def traced():
+        return 1
+
+    ray_tpu.get(traced.remote())
+    events = ray_tpu.timeline()
+    assert any(e["name"] == "traced" for e in events)

@@ -1,0 +1,173 @@
+"""Scheduler kernel tests: pure-function placement on synthetic resource views.
+
+Mirrors the reference's scheduler test style
+(src/ray/raylet/scheduling/cluster_resource_scheduler_test.cc,
+policy/hybrid_scheduling_policy_test.cc): build a synthetic cluster view,
+call the kernel, assert node choices. Plus NumPy<->JAX golden equality.
+"""
+
+import numpy as np
+import pytest
+
+from ray_tpu.sched import kernel_np
+from ray_tpu.sched.resources import NodeResourceState, ResourceSpace, pack_demands
+
+
+def make_state(node_resources):
+    space = ResourceSpace()
+    st = NodeResourceState(space=space)
+    for i, res in enumerate(node_resources):
+        st.add_node(f"n{i}", res)
+    return st
+
+
+def test_greedy_prefers_local_under_threshold():
+    st = make_state([{"CPU": 8}, {"CPU": 8}])
+    demands = pack_demands(st.space, [{"CPU": 1}] * 4)
+    out, avail = kernel_np.greedy_assign(st.available, st.total, st.alive, demands)
+    # All fit on node 0 while it stays under the 50% threshold.
+    assert out.tolist() == [0, 0, 0, 0]
+    assert avail[0][0] == 4.0
+
+
+def test_greedy_spreads_past_threshold():
+    st = make_state([{"CPU": 4}, {"CPU": 4}])
+    demands = pack_demands(st.space, [{"CPU": 1}] * 8)
+    out, _ = kernel_np.greedy_assign(st.available, st.total, st.alive, demands)
+    # 2 on node0 (reaches 50%), then the task crossing threshold still lands
+    # local, then utilization balancing kicks in; both nodes end full.
+    counts = np.bincount(out, minlength=2)
+    assert counts.tolist() == [4, 4]
+
+
+def test_greedy_infeasible_is_unassigned():
+    st = make_state([{"CPU": 2}])
+    demands = pack_demands(st.space, [{"CPU": 4}, {"GPU": 1}])
+    out, _ = kernel_np.greedy_assign(st.available, st.total, st.alive, demands)
+    assert out.tolist() == [-1, -1]
+
+
+def test_greedy_custom_resources_mask():
+    st = make_state([{"CPU": 4}, {"CPU": 4, "accel": 2}])
+    demands = pack_demands(st.space, [{"CPU": 1, "accel": 1}] * 2)
+    out, _ = kernel_np.greedy_assign(st.available, st.total, st.alive, demands)
+    assert out.tolist() == [1, 1]
+
+
+def test_dead_node_excluded():
+    st = make_state([{"CPU": 4}, {"CPU": 4}])
+    st.remove_node("n0")
+    demands = pack_demands(st.space, [{"CPU": 1}] * 2)
+    out, _ = kernel_np.greedy_assign(st.available, st.total, st.alive, demands)
+    assert out.tolist() == [1, 1]
+
+
+def test_class_kernel_matches_greedy_totals():
+    """Class-batched counts must land tasks on the same nodes per-task greedy
+    does (same totals; order within a class is interchangeable)."""
+    rng = np.random.default_rng(0)
+    st = make_state([{"CPU": float(c), "memory": float(m)}
+                     for c, m in zip(rng.integers(2, 16, 8), rng.integers(4, 64, 8))])
+    demand_maps = [{"CPU": 1}, {"CPU": 2, "memory": 1}]
+    counts = np.array([10, 5], dtype=np.int32)
+    demands = pack_demands(st.space, demand_maps)
+
+    assigned, _ = kernel_np.schedule_classes(
+        st.available, st.total, st.alive, demands, counts
+    )
+    assert assigned.sum() == counts.sum()
+    # per-task expansion of the same workload
+    expand = np.repeat(demands, counts, axis=0)
+    greedy, _ = kernel_np.greedy_assign(st.available, st.total, st.alive, expand)
+    assert (greedy >= 0).all()
+    # both respect capacity
+    for n in range(len(st)):
+        used = sum(demands[c] * assigned[c, n] for c in range(2))
+        assert (used <= st.total[n] + 1e-3).all()
+
+
+def test_class_kernel_partial_when_cluster_full():
+    st = make_state([{"CPU": 3}])
+    demands = pack_demands(st.space, [{"CPU": 1}])
+    counts = np.array([10], dtype=np.int32)
+    assigned, avail = kernel_np.schedule_classes(
+        st.available, st.total, st.alive, demands, counts
+    )
+    assert assigned.sum() == 3
+    assert avail[0][0] == 0.0
+
+
+def test_np_jax_golden_equality():
+    """The north-star requirement: the jax kernel is decision-identical to
+    the NumPy fallback on the same inputs."""
+    import jax.numpy as jnp
+    from ray_tpu.sched import kernel_jax
+
+    rng = np.random.default_rng(42)
+    N, C = 64, 7
+    space = ResourceSpace()
+    st = NodeResourceState(space=space)
+    for i in range(N):
+        st.add_node(
+            f"n{i}",
+            {"CPU": float(rng.integers(1, 32)),
+             "memory": float(rng.integers(8, 128)),
+             "TPU": float(rng.choice([0, 0, 4, 8]))},
+        )
+    # fragment some availability
+    st.available = st.available * rng.uniform(0.3, 1.0, size=st.available.shape).astype(np.float32)
+    st.available = np.floor(st.available)
+    demand_maps = []
+    for _ in range(C):
+        d = {"CPU": float(rng.integers(1, 4))}
+        if rng.random() < 0.4:
+            d["TPU"] = float(rng.integers(1, 4))
+        if rng.random() < 0.5:
+            d["memory"] = float(rng.integers(1, 8))
+        demand_maps.append(d)
+    demands = pack_demands(space, demand_maps)
+    counts = rng.integers(1, 200, size=C).astype(np.int32)
+
+    np_assigned, np_avail = kernel_np.schedule_classes(
+        st.available, st.total, st.alive, demands, counts
+    )
+    jx_assigned, jx_avail = kernel_jax.schedule_classes(
+        jnp.asarray(st.available), jnp.asarray(st.total), jnp.asarray(st.alive),
+        jnp.asarray(demands), jnp.asarray(counts),
+    )
+    np.testing.assert_array_equal(np_assigned, np.asarray(jx_assigned))
+    np.testing.assert_allclose(np_avail, np.asarray(jx_avail), atol=1e-3)
+
+
+def test_jax_padded_matches_unpadded():
+    import jax.numpy as jnp
+    from ray_tpu.sched import kernel_jax
+
+    st = make_state([{"CPU": 8}, {"CPU": 16}, {"CPU": 4}])
+    demands = pack_demands(st.space, [{"CPU": 2}])
+    counts = np.array([9], dtype=np.int32)
+    d, k = kernel_jax.pad_problem(demands, counts, 16)
+    a1, _ = kernel_jax.schedule_classes(
+        jnp.asarray(st.available), jnp.asarray(st.total), jnp.asarray(st.alive),
+        jnp.asarray(d), jnp.asarray(k),
+    )
+    a2, _ = kernel_np.schedule_classes(
+        st.available, st.total, st.alive, demands, counts
+    )
+    np.testing.assert_array_equal(np.asarray(a1[:1]), a2)
+    assert int(np.asarray(a1[1:]).sum()) == 0
+
+
+def test_spread_round_robin():
+    st = make_state([{"CPU": 4}] * 4)
+    demands = pack_demands(st.space, [{"CPU": 1}] * 8)
+    out, _ = kernel_np.spread_assign(st.available, st.total, st.alive, demands)
+    assert out.tolist() == [0, 1, 2, 3, 0, 1, 2, 3]
+
+
+def test_expand_class_assignment():
+    assigned = np.array([[2, 1], [0, 3]], dtype=np.int32)
+    pairs = kernel_np.expand_class_assignment(
+        assigned, [["a", "b", "c"], ["d", "e", "f"]]
+    )
+    assert dict(pairs) == {"a": 0, "b": 0, "c": 1, "d": 1, "e": 1, "f": 1}
