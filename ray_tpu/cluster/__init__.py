@@ -1,0 +1,26 @@
+"""Multi-process cluster runtime.
+
+Reference architecture (SURVEY §1): GCS (src/ray/gcs/gcs_server/) + per-node
+raylet (src/ray/raylet/) + per-process core workers, all talking gRPC.
+
+TPU-first redesign: placement is *centralized* in the head process as batched
+kernel rounds (the whole pending queue -> one [classes x nodes] assignment per
+round, on TPU via sched.kernel_jax or the NumPy fallback), instead of Ray's
+per-raylet local schedulers with spillback. Rationale: Ray distributes
+scheduling because each raylet decides one task at a time; once placement is
+a batched matrix program, a single global round is both faster and makes
+strictly better-informed decisions. The submitter-side lease cache (reuse a
+leased worker for same-class tasks, reference normal_task_submitter.cc) is
+kept — that's the latency fast path that bypasses rounds entirely.
+
+Processes:
+  head:    GcsServer — tables (nodes/actors/jobs/PGs), object directory,
+           pubsub, health checks, THE scheduler.
+  node:    NodeDaemon — worker pool (subprocess workers), local object
+           store, object transfer, lease execution.
+  client:  ClusterClient — the driver runtime behind ray_tpu.init(address=...).
+"""
+
+from ray_tpu.cluster.cluster_utils import Cluster
+
+__all__ = ["Cluster"]

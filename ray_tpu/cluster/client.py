@@ -1,0 +1,443 @@
+"""Driver-side cluster runtime: the object behind ray_tpu.init(address=...).
+
+Implements the same runtime interface LocalRuntime exposes (submit_task /
+get / put / wait / kill_actor / nodes / ...) so ray_tpu.core.api is
+mode-agnostic. Fills the submitter half of the reference's core worker
+(src/ray/core_worker/core_worker.cc SubmitTask/Get + task_manager.cc retries
+and lineage; transport/normal_task_submitter.cc lease reuse is subsumed by
+the GCS's centralized batched rounds — see cluster/__init__.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.core import serialization
+from ray_tpu.core.config import Config
+from ray_tpu.core.exceptions import (
+    ActorDiedError,
+    GetTimeoutError,
+    ObjectLostError,
+    TaskError,
+)
+from ray_tpu.core.memory_store import MemoryStore
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.task_spec import TaskSpec, new_id
+from ray_tpu.cluster.rpc import ConnectionLost, RpcClient
+
+
+def _parse_address(address) -> Tuple[str, int]:
+    if isinstance(address, tuple):
+        return address
+    addr = address.replace("tcp://", "").replace("ray_tpu://", "")
+    host, port = addr.rsplit(":", 1)
+    return host, int(port)
+
+
+class ClusterClient:
+    def __init__(self, address, config: Optional[Config] = None):
+        self.config = config or Config()
+        host, port = _parse_address(address)
+        self.gcs = RpcClient(host, port)
+        self.worker_id = new_id("driver")
+        self.node_id = "driver"
+        self.store = MemoryStore()  # resolved values (inline or fetched)
+        self._lock = threading.Lock()
+        self._task_meta: Dict[str, dict] = {}  # task_id -> submitted meta (retries, lineage)
+        self._ref_index: Dict[str, str] = {}  # object_id -> task_id (lineage)
+        self._result_ready: Dict[str, dict] = {}  # task_id -> result payload meta
+        self._actor_cache: Dict[str, dict] = {}
+        self._actor_queues: Dict[str, Any] = {}
+        self._daemon_conns: Dict[str, RpcClient] = {}
+        self.gcs.subscribe("task_result", self._on_task_result)
+        self.gcs.subscribe("actor_update", self._on_actor_update)
+        self.gcs.subscribe("nodes", self._on_nodes)
+        reply = self.gcs.call("register_driver", {"driver_id": self.worker_id})
+        self._nodes: Dict[str, dict] = reply["nodes"]
+        self._put_rr = 0
+
+    # ----------------------------------------------------------- submission
+
+    def submit_task(self, spec: TaskSpec) -> List[ObjectRef]:
+        refs = [
+            ObjectRef.for_task_output(spec.task_id, i, owner=self.worker_id)
+            for i in range(spec.num_returns)
+        ]
+        if spec.actor_id is not None and not spec.actor_creation:
+            self._submit_actor_call(spec, refs)
+            return refs
+        meta = self._make_meta(spec)
+        if spec.actor_creation:
+            self.gcs.call("register_actor", {
+                "actor_id": spec.actor_id,
+                "class_name": getattr(spec.func, "__name__", "Actor"),
+                "max_restarts": spec.max_restarts,
+                "name": spec.name,
+            })
+        with self._lock:
+            self._task_meta[spec.task_id] = meta
+            for r in refs:
+                self._ref_index[r.id] = spec.task_id
+        self.gcs.call("submit_task", meta)
+        return refs
+
+    def _make_meta(self, spec: TaskSpec) -> dict:
+        spec_bytes = serialization.dumps({
+            "func": spec.func,
+            "args": spec.args,
+            "kwargs": spec.kwargs,
+            "method_name": spec.method_name,
+        })
+        return {
+            "task_id": spec.task_id,
+            "name": spec.name,
+            "class_key": spec.scheduling_class(),
+            "resources": dict(spec.resources),
+            "spec_bytes": spec_bytes,
+            "num_returns": spec.num_returns,
+            "owner": self.worker_id,
+            "actor_id": spec.actor_id,
+            "actor_creation": spec.actor_creation,
+            "retries_left": spec.retries_left,
+            "strategy": {
+                "kind": spec.strategy.kind,
+                "node_id": spec.strategy.node_id,
+                "soft": spec.strategy.soft,
+                "placement_group_id": spec.strategy.placement_group_id,
+                "bundle_index": spec.strategy.bundle_index,
+            },
+        }
+
+    # ------------------------------------------------------------ actor path
+
+    def _submit_actor_call(self, spec: TaskSpec, refs: List[ObjectRef]):
+        """Ordered actor submission: one dispatcher thread per actor sends
+        calls in submit order on one connection — frame order IS execution
+        order at the actor (reference: actor_task_submitter.cc +
+        actor_submit_queue.h sequence numbers). Responses resolve
+        concurrently via future callbacks."""
+        meta = self._make_meta(spec)
+        with self._lock:
+            q = self._actor_queues.get(spec.actor_id)
+            if q is None:
+                import queue as _queue
+
+                q = _queue.Queue()
+                self._actor_queues[spec.actor_id] = q
+                t = threading.Thread(
+                    target=self._actor_dispatch_loop,
+                    args=(spec.actor_id, q),
+                    daemon=True,
+                    name=f"actor-dispatch-{spec.actor_id[:8]}",
+                )
+                t.start()
+        q.put((meta, refs))
+
+    def _actor_dispatch_loop(self, actor_id: str, q):
+        while True:
+            item = q.get()
+            if item is None:
+                return
+            meta, refs = item
+
+            def fail(err, refs=refs):
+                for r in refs:
+                    self.store.put(r, err, is_exception=True)
+
+            try:
+                info = self._actor_location(actor_id, wait=True, timeout=60)
+                if info is None or info.get("state") == "DEAD":
+                    fail(ActorDiedError(f"actor {actor_id} is dead"))
+                    continue
+                daemon = self._daemon(info["node_id"], info["addr"], info["port"])
+                fut = daemon.call_async("actor_call", meta)
+            except (ConnectionLost, OSError, Exception) as e:  # noqa: BLE001
+                fail(ActorDiedError(f"actor call failed: {e!r}"))
+                continue
+
+            def on_done(f, refs=refs):
+                try:
+                    self._ingest_result(f.result(), refs)
+                except (ConnectionLost, OSError) as e:
+                    for r in refs:
+                        self.store.put(
+                            r, ActorDiedError(f"actor node unreachable: {e}"),
+                            is_exception=True,
+                        )
+                except Exception as e:  # noqa: BLE001
+                    for r in refs:
+                        self.store.put(
+                            r, TaskError(f"actor call failed: {e!r}"),
+                            is_exception=True,
+                        )
+
+            fut.add_done_callback(on_done)
+
+    def _actor_location(self, actor_id, wait=False, timeout=30.0):
+        deadline = time.time() + timeout
+        while True:
+            with self._lock:
+                info = self._actor_cache.get(actor_id)
+            if info and info.get("state") == "ALIVE" and info.get("node_id"):
+                return info
+            info = self.gcs.call("get_actor", {"actor_id": actor_id})
+            if info:
+                with self._lock:
+                    self._actor_cache[actor_id] = info
+                if info.get("state") == "ALIVE" and info.get("addr"):
+                    return info
+                if info.get("state") == "DEAD":
+                    return info
+            if not wait or time.time() > deadline:
+                return info
+            time.sleep(0.05)
+
+    def _on_actor_update(self, p):
+        with self._lock:
+            info = self._actor_cache.get(p["actor_id"])
+            if info is not None:
+                info["state"] = p["state"]
+
+    # ------------------------------------------------------------- results
+
+    def _on_task_result(self, p: dict):
+        task_id = p["task_id"]
+        status = p.get("status")
+        with self._lock:
+            meta = self._task_meta.get(task_id)
+        if status in ("NODE_DIED", "WORKER_DIED") and meta is not None:
+            if meta.get("retries_left", 0) > 0:
+                meta["retries_left"] -= 1
+                try:
+                    self.gcs.call("submit_task", meta)
+                    return
+                except Exception:
+                    pass
+            refs = [
+                ObjectRef.for_task_output(task_id, i, owner=self.worker_id)
+                for i in range(meta.get("num_returns", 1))
+            ]
+            err = TaskError(f"task failed after retries: {p.get('error')}")
+            for r in refs:
+                self.store.put(r, err, is_exception=True)
+            return
+        refs = [
+            ObjectRef.for_task_output(task_id, i, owner=self.worker_id)
+            for i in range(meta.get("num_returns", 1) if meta else len(p.get("results", [])) or 1)
+        ]
+        self._ingest_result(p, refs)
+
+    def _ingest_result(self, p: dict, refs: List[ObjectRef]):
+        inline = p.get("inline", {})
+        result_ids = {oid for oid, _ in p.get("results", [])}
+        for r in refs:
+            if r.id in inline:
+                rec = serialization.unpack(inline[r.id])
+                self.store.put(r, rec["v"], is_exception=rec["e"])
+            elif r.id in result_ids:
+                # large result: remember location meta; fetched lazily on get
+                with self._lock:
+                    self._result_ready[r.id] = {"node_id": p["node_id"]}
+                self.store.put(r, ("__remote__", p["node_id"]), is_exception=False)
+            elif p.get("status") not in ("FINISHED", None):
+                self.store.put(
+                    r,
+                    TaskError(f"task failed: {p.get('error')}"),
+                    is_exception=True,
+                )
+
+    # --------------------------------------------------------------- objects
+
+    def put(self, value: Any) -> ObjectRef:
+        ref = ObjectRef(owner=self.worker_id)
+        payload = serialization.pack({"e": False, "v": value})
+        node = self._pick_put_node()
+        if node is None:
+            # no nodes yet: keep locally; remote workers can't fetch it, but
+            # a clusterless driver can still get() it back
+            self.store.put(ref, value)
+            return ref
+        daemon = self._daemon(node["node_id"], node["addr"], node["port"])
+        daemon.call("put_object", {"object_id": ref.id, "payload": payload})
+        self.store.put(ref, value)  # local cache
+        return ref
+
+    def _pick_put_node(self):
+        with self._lock:
+            alive = [
+                dict(node_id=nid, **{k: n[k] for k in ("addr", "port")})
+                for nid, n in self._nodes.items()
+                if n.get("alive", True)
+            ]
+            if not alive:
+                return None
+            self._put_rr += 1
+            return alive[self._put_rr % len(alive)]
+
+    def _on_nodes(self, snapshot):
+        with self._lock:
+            self._nodes = snapshot
+
+    def _daemon(self, node_id, addr, port) -> RpcClient:
+        with self._lock:
+            c = self._daemon_conns.get(node_id)
+            if c is not None and not c._closed:
+                return c
+        c = RpcClient(addr, port)
+        with self._lock:
+            self._daemon_conns[node_id] = c
+        return c
+
+    def _fetch(self, ref: ObjectRef, timeout: float, allow_reconstruct: bool) -> Any:
+        """Fetch a remote object payload via the directory; on total loss,
+        resubmit the creating task once (lineage reconstruction, reference:
+        object_recovery_manager.cc + lineage pinning in reference_count.cc)."""
+        deadline = time.time() + timeout
+        attempted_reconstruct = False
+        while time.time() < deadline:
+            loc = self.gcs.call("locate_object", {"object_id": ref.id})
+            for entry in loc.get("nodes", []):
+                daemon = self._daemon(entry["node_id"], entry["addr"], entry["port"])
+                try:
+                    payload = daemon.call(
+                        "fetch_object", {"object_id": ref.id, "timeout": 5.0},
+                        timeout=30.0,
+                    )
+                except (ConnectionLost, OSError):
+                    continue
+                if payload is not None:
+                    rec = serialization.unpack(payload)
+                    self.store.put(ref, rec["v"], is_exception=rec["e"])
+                    if rec["e"]:
+                        raise rec["v"]
+                    return rec["v"]
+            if not loc.get("nodes") and allow_reconstruct and not attempted_reconstruct:
+                attempted_reconstruct = True
+                task_id = ref.task_id or self._ref_index.get(ref.id)
+                with self._lock:
+                    meta = self._task_meta.get(task_id) if task_id else None
+                if meta is not None:
+                    # result will arrive via the normal task_result push
+                    self.store.delete([ref])
+                    self.gcs.call("submit_task", meta)
+                    return self._get_one(ref, deadline)
+            time.sleep(0.05)
+        raise ObjectLostError(f"object {ref.id[:8]} could not be retrieved")
+
+    # ------------------------------------------------------------- data api
+
+    def _get_one(self, ref: ObjectRef, deadline: Optional[float]) -> Any:
+        owned = ref.id in self._ref_index or ref.owner == self.worker_id
+        while True:
+            e = self.store.try_get(ref)
+            if e is not None:
+                if e.is_exception:
+                    raise e.value if isinstance(e.value, BaseException) else TaskError(str(e.value))
+                if (
+                    isinstance(e.value, tuple)
+                    and len(e.value) == 2
+                    and e.value[0] == "__remote__"
+                ):
+                    remaining = 60.0 if deadline is None else max(0.1, deadline - time.time())
+                    return self._fetch(ref, remaining, allow_reconstruct=True)
+                return e.value
+            if deadline is not None and time.time() >= deadline:
+                raise GetTimeoutError(f"get timed out on {ref.id[:8]}")
+            if not owned:
+                # produced by another worker/driver: poll the directory
+                loc = self.gcs.call("locate_object", {"object_id": ref.id})
+                if loc.get("nodes"):
+                    remaining = 30.0 if deadline is None else max(0.1, deadline - time.time())
+                    return self._fetch(ref, remaining, allow_reconstruct=False)
+            try:
+                self.store.get([ref], timeout=0.1)
+            except GetTimeoutError:
+                pass
+
+    def get(self, refs: List[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
+        deadline = time.time() + timeout if timeout is not None else None
+        return [self._get_one(ref, deadline) for ref in refs]
+
+    def wait(self, refs, num_returns=1, timeout=None):
+        """Owned refs resolve via task_result pushes into the local store
+        (condition-variable wait, no polling); only refs owned elsewhere
+        consult the GCS directory, at a coarse interval."""
+        deadline = time.time() + timeout if timeout is not None else None
+        foreign = [
+            r for r in refs
+            if r.id not in self._ref_index and r.owner != self.worker_id
+        ]
+        foreign_ready: set = set()
+        last_dir_poll = 0.0
+        while True:
+            ready = [
+                r for r in refs
+                if self.store.contains(r) or r.id in foreign_ready
+            ]
+            if len(ready) >= num_returns:
+                break
+            if deadline is not None and time.time() >= deadline:
+                break
+            if foreign and time.time() - last_dir_poll > 0.25:
+                last_dir_poll = time.time()
+                for r in foreign:
+                    if r.id in foreign_ready:
+                        continue
+                    loc = self.gcs.call("locate_object", {"object_id": r.id})
+                    if loc.get("nodes"):
+                        foreign_ready.add(r.id)
+                continue
+            remaining = 0.2 if deadline is None else min(0.2, deadline - time.time())
+            self.store.wait(refs, num_returns, timeout=max(0.05, remaining))
+        ready_set = {r.id for r in ready[:num_returns]}
+        return (
+            [r for r in refs if r.id in ready_set],
+            [r for r in refs if r.id not in ready_set],
+        )
+
+    def free(self, refs: List[ObjectRef]):
+        self.store.delete(refs)
+        self.gcs.call("free_objects", {"object_ids": [r.id for r in refs]})
+
+    # ---------------------------------------------------------------- misc
+
+    def kill_actor(self, actor_id: str, no_restart: bool = True):
+        self.gcs.call("kill_actor", {"actor_id": actor_id})
+        with self._lock:
+            info = self._actor_cache.get(actor_id)
+            if info is not None:
+                info["state"] = "DEAD"
+
+    def cluster_resources(self) -> Dict[str, float]:
+        return self.gcs.call("cluster_resources")
+
+    def available_resources(self) -> Dict[str, float]:
+        return self.gcs.call("available_resources")
+
+    def nodes(self) -> List[dict]:
+        raw = self.gcs.call("get_nodes")
+        return [
+            {"NodeID": nid, "Alive": n["alive"], "Resources": n["resources"],
+             "Labels": n.get("labels", {})}
+            for nid, n in raw.items()
+        ]
+
+    def timeline(self) -> List[dict]:
+        return self.gcs.call("list_tasks")
+
+    def current_task_id(self):
+        return None
+
+    def current_actor_id(self):
+        return None
+
+    def shutdown(self):
+        for q in self._actor_queues.values():
+            q.put(None)
+        for c in self._daemon_conns.values():
+            c.close()
+        self.gcs.close()

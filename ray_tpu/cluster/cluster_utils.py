@@ -1,0 +1,85 @@
+"""Test/dev cluster harness.
+
+Reference: python/ray/cluster_utils.py (Cluster / AutoscalingCluster) — the
+fixture that makes "multi-node" testable on one machine: one GCS plus N node
+daemons with *declarative* fake resources (SURVEY §4). Daemons run in-process
+(each is its own threads + rpc server); workers are real subprocesses, so
+task execution still crosses process boundaries like production.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+from ray_tpu.core.config import Config
+from ray_tpu.cluster.gcs import GcsServer
+from ray_tpu.cluster.node_daemon import NodeDaemon
+
+
+class Cluster:
+    def __init__(self, config: Optional[Config] = None, host: str = "127.0.0.1"):
+        self.config = config or Config()
+        self.host = host
+        self.gcs = GcsServer(host=host, config=self.config)
+        self.daemons = []
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.gcs.port}"
+
+    def add_node(
+        self,
+        num_cpus: float = 4,
+        num_tpus: float = 0,
+        memory: float = 2**31,
+        resources: Optional[Dict[str, float]] = None,
+        labels: Optional[Dict[str, str]] = None,
+        node_id: Optional[str] = None,
+    ) -> NodeDaemon:
+        res = {"CPU": float(num_cpus), "memory": float(memory)}
+        if num_tpus:
+            res["TPU"] = float(num_tpus)
+        res.update(resources or {})
+        daemon = NodeDaemon(
+            (self.host, self.gcs.port), res,
+            node_id=node_id, config=self.config, host=self.host, labels=labels,
+        )
+        self.daemons.append(daemon)
+        return daemon
+
+    def remove_node(self, daemon: NodeDaemon):
+        daemon.shutdown()
+        if daemon in self.daemons:
+            self.daemons.remove(daemon)
+
+    def kill_node(self, daemon: NodeDaemon):
+        """Hard kill for fault-injection tests (reference: test_utils node
+        killer used by test_chaos.py): drop the GCS connection and all
+        workers without cleanup."""
+        for w in list(daemon.workers.values()):
+            if w.proc is not None:
+                try:
+                    w.proc.kill()
+                except OSError:
+                    pass
+        daemon.gcs.close()
+        daemon.server.stop()
+        daemon._stopped = True
+        if daemon in self.daemons:
+            self.daemons.remove(daemon)
+
+    def wait_for_nodes(self, n: int, timeout: float = 10.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            alive = sum(1 for v in self.gcs.nodes.values() if v["alive"])
+            if alive >= n:
+                return
+            time.sleep(0.05)
+        raise TimeoutError(f"cluster did not reach {n} nodes")
+
+    def shutdown(self):
+        for d in list(self.daemons):
+            d.shutdown()
+        self.daemons.clear()
+        self.gcs.shutdown()

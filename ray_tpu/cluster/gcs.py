@@ -1,0 +1,646 @@
+"""GCS head process: cluster metadata authority + THE scheduler.
+
+Reference: src/ray/gcs/gcs_server/ — gcs_server.cc wiring gcs_node_manager.cc
+(node table + death broadcast), gcs_actor_manager.cc (actor table/restart),
+gcs_job_manager.cc, gcs_placement_group_manager.cc (2PC bundle commit),
+gcs_health_check_manager.cc (liveness), plus pub/sub and table storage.
+
+Deviation (TPU-first): cluster-wide task placement lives HERE as batched
+kernel rounds over the whole pending queue (see ray_tpu/cluster/__init__.py
+rationale), not in per-node raylets. The GCS therefore also absorbs the role
+of ClusterTaskManager/ClusterResourceScheduler (src/ray/raylet/scheduling/).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from collections import defaultdict, deque
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.core.config import Config
+from ray_tpu.cluster.rpc import RpcServer
+from ray_tpu.sched.policy import make_policy
+from ray_tpu.sched.resources import NodeResourceState, ResourceSpace
+from ray_tpu.sched import bundles as bundles_mod
+
+
+class GcsServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, config: Optional[Config] = None):
+        self.config = config or Config()
+        self.space = ResourceSpace()
+        self.state = NodeResourceState(space=self.space)
+        self.policy = make_policy(self.config.scheduling_policy)
+        self._lock = threading.RLock()
+
+        # --- tables (reference: gcs_table_storage.cc) ---
+        self.nodes: Dict[str, dict] = {}  # node_id -> {addr, port, resources, alive, conn_id, last_beat}
+        self.actors: Dict[str, dict] = {}  # actor_id -> {node_id, state, spec_bytes, restarts_left, class_name}
+        self.jobs: Dict[str, dict] = {}
+        self.placement_groups: Dict[str, dict] = {}
+        self.kv: Dict[str, bytes] = {}
+        self.directory: Dict[str, set] = defaultdict(set)  # object_id -> {node_id}
+        self.drivers: Dict[int, dict] = {}  # conn_id -> {driver_id}
+        self.task_events: deque = deque(maxlen=100000)
+
+        # --- scheduler state ---
+        self.pending: deque = deque()  # (spec_meta dict)
+        self.running: Dict[str, dict] = {}  # task_id -> {node_id, demand, owner_conn}
+        self.actors_pending_node: Dict[str, str] = {}
+
+        self.server = RpcServer(
+            self._handle, host=host, port=port,
+            on_disconnect=self._on_disconnect, name="gcs",
+        )
+        self.port = self.server.start()
+        self.addr = (host, self.port)
+        self._stopped = False
+        self._sched_cv = threading.Condition()
+        self._sched_thread = threading.Thread(
+            target=self._sched_loop, daemon=True, name="gcs-sched"
+        )
+        self._sched_thread.start()
+        self._health_thread = threading.Thread(
+            target=self._health_loop, daemon=True, name="gcs-health"
+        )
+        self._health_thread.start()
+
+    # ------------------------------------------------------------------ rpc
+
+    def _handle(self, method: str, params: Any, conn):
+        fn = getattr(self, f"rpc_{method}", None)
+        if fn is None:
+            raise ValueError(f"unknown GCS method {method}")
+        return fn(params or {}, conn)
+
+    # --- node lifecycle (reference: gcs_node_manager.cc) ---
+
+    def rpc_register_node(self, p, conn):
+        with self._lock:
+            node_id = p["node_id"]
+            self.nodes[node_id] = {
+                "node_id": node_id,
+                "addr": p["addr"],
+                "port": p["port"],
+                "resources": p["resources"],
+                "alive": True,
+                "conn_id": conn.conn_id,
+                "last_beat": time.time(),
+                "labels": p.get("labels", {}),
+            }
+            conn.meta["node_id"] = node_id
+            if self.state.node_index(node_id) is None:
+                self.state.add_node(node_id, p["resources"], p.get("labels"))
+            else:
+                # re-registration after a death: revive the scheduler row
+                self.state.revive_node(node_id, p["resources"])
+            self._publish_nodes()
+        self._kick()
+        return {"ok": True, "node_index": self.state.node_index(node_id)}
+
+    def rpc_heartbeat(self, p, conn):
+        with self._lock:
+            n = self.nodes.get(p["node_id"])
+            if n:
+                n["last_beat"] = time.time()
+        return {"ok": True}
+
+    def rpc_get_nodes(self, p, conn):
+        with self._lock:
+            return {
+                nid: {k: n[k] for k in ("addr", "port", "resources", "alive", "labels")}
+                for nid, n in self.nodes.items()
+            }
+
+    def rpc_register_driver(self, p, conn):
+        with self._lock:
+            self.drivers[conn.conn_id] = {"driver_id": p["driver_id"], "conn": conn}
+            conn.meta["driver_id"] = p["driver_id"]
+            self.jobs[p["driver_id"]] = {
+                "job_id": p["driver_id"], "start": time.time(), "state": "RUNNING",
+            }
+        return {"ok": True, "nodes": self.rpc_get_nodes({}, conn)}
+
+    # --- scheduling entry (reference: ClusterTaskManager::QueueAndScheduleTask) ---
+
+    def rpc_submit_task(self, p, conn):
+        """p: task meta {task_id, class_key, resources, spec_bytes, owner,
+        actor_id?, actor_creation?, num_returns, strategy}."""
+        with self._lock:
+            p["owner_conn"] = conn.conn_id
+            p["enqueued_at"] = time.time()
+            self.pending.append(p)
+        self._kick()
+        return {"ok": True}
+
+    def rpc_task_done(self, p, conn):
+        """From a node daemon: task finished. p: {task_id, node_id, status,
+        results: [(oid, size)], inline: {oid: bytes}, error?, actor_id?}"""
+        with self._lock:
+            info = self.running.pop(p["task_id"], None)
+            if info is not None:
+                if p.get("actor_creation") and p.get("status") == "FINISHED":
+                    # alive actors hold their allocation for their lifetime
+                    # (released by kill_actor / node death)
+                    self.running[f"actor-hold-{p['actor_id']}"] = info
+                else:
+                    idx = self.state.node_index(info["node_id"])
+                    if idx is not None:
+                        self.state.release(idx, info["demand"])
+            for oid, size in p.get("results", []):
+                self.directory[oid].add(p["node_id"])
+            self.task_events.append(
+                {k: p.get(k) for k in ("task_id", "node_id", "status", "name",
+                                       "start", "end", "actor_id")}
+            )
+            owner_conn = info["owner_conn"] if info else p.get("owner_conn")
+            if p.get("actor_creation") and p.get("actor_id"):
+                a = self.actors.get(p["actor_id"])
+                if a is not None:
+                    a["state"] = "ALIVE" if p["status"] == "FINISHED" else "DEAD"
+            target = self._driver_conn(owner_conn)
+        if target is not None:
+            self.server.call_soon(
+                lambda: __import__("asyncio").ensure_future(
+                    target.push("task_result", p)
+                )
+            )
+        self._kick()
+        return {"ok": True}
+
+    def _driver_conn(self, conn_id):
+        d = self.drivers.get(conn_id)
+        return d["conn"] if d else None
+
+    # --- object directory (reference: ownership_object_directory.cc) ---
+
+    def rpc_add_object_location(self, p, conn):
+        with self._lock:
+            self.directory[p["object_id"]].add(p["node_id"])
+        return {"ok": True}
+
+    def rpc_locate_object(self, p, conn):
+        with self._lock:
+            nodes = [
+                nid for nid in self.directory.get(p["object_id"], set())
+                if self.nodes.get(nid, {}).get("alive")
+            ]
+            return {
+                "nodes": [
+                    {"node_id": nid, "addr": self.nodes[nid]["addr"],
+                     "port": self.nodes[nid]["port"]}
+                    for nid in nodes
+                ]
+            }
+
+    def rpc_free_objects(self, p, conn):
+        with self._lock:
+            homes = defaultdict(list)
+            for oid in p["object_ids"]:
+                for nid in self.directory.pop(oid, set()):
+                    homes[nid].append(oid)
+        for nid, oids in homes.items():
+            self._push_to_node(nid, "free_objects", {"object_ids": oids})
+        return {"ok": True}
+
+    # --- actor table (reference: gcs_actor_manager.cc) ---
+
+    def rpc_register_actor(self, p, conn):
+        with self._lock:
+            self.actors[p["actor_id"]] = {
+                "actor_id": p["actor_id"],
+                "state": "PENDING",
+                "node_id": None,
+                "class_name": p.get("class_name", ""),
+                "max_restarts": p.get("max_restarts", 0),
+                "restarts": 0,
+                "owner_conn": conn.conn_id,
+                "name": p.get("name"),
+            }
+        return {"ok": True}
+
+    def rpc_get_actor(self, p, conn):
+        with self._lock:
+            a = self.actors.get(p["actor_id"])
+            if a is None:
+                return None
+            out = {k: a[k] for k in ("actor_id", "state", "node_id", "class_name")}
+            n = self.nodes.get(a["node_id"]) if a["node_id"] else None
+            if n:
+                out["addr"] = n["addr"]
+                out["port"] = n["port"]
+            return out
+
+    def rpc_actor_died(self, p, conn):
+        with self._lock:
+            a = self.actors.get(p["actor_id"])
+            if a:
+                a["state"] = "DEAD"
+                a["death_cause"] = p.get("cause", "")
+        self.server.broadcast("actor_update", {"actor_id": p["actor_id"], "state": "DEAD"})
+        return {"ok": True}
+
+    def rpc_kill_actor(self, p, conn):
+        with self._lock:
+            a = self.actors.get(p["actor_id"])
+            if a is None:
+                return {"ok": False}
+            nid = a["node_id"]
+            a["state"] = "DEAD"
+            info = self.running.pop(f"actor-hold-{p['actor_id']}", None)
+            if info is not None:
+                idx = self.state.node_index(info["node_id"])
+                if idx is not None:
+                    self.state.release(idx, info["demand"])
+        if nid:
+            self._push_to_node(nid, "kill_actor", {"actor_id": p["actor_id"]})
+        self.server.broadcast("actor_update", {"actor_id": p["actor_id"], "state": "DEAD"})
+        return {"ok": True}
+
+    # --- kv (reference: gcs internal kv used for named actors etc.) ---
+
+    def rpc_kv_put(self, p, conn):
+        with self._lock:
+            self.kv[p["key"]] = p["value"]
+        return {"ok": True}
+
+    def rpc_kv_get(self, p, conn):
+        with self._lock:
+            return self.kv.get(p["key"])
+
+    def rpc_kv_del(self, p, conn):
+        with self._lock:
+            self.kv.pop(p["key"], None)
+        return {"ok": True}
+
+    def rpc_kv_keys(self, p, conn):
+        with self._lock:
+            prefix = p.get("prefix", "")
+            return [k for k in self.kv if k.startswith(prefix)]
+
+    # --- state API backing (reference: python/ray/util/state, gcs_task_manager.cc) ---
+
+    def rpc_cluster_resources(self, p, conn):
+        with self._lock:
+            agg: Dict[str, float] = defaultdict(float)
+            for m in self.state.total_map().values():
+                for k, v in m.items():
+                    agg[k] += v
+            return dict(agg)
+
+    def rpc_available_resources(self, p, conn):
+        with self._lock:
+            agg: Dict[str, float] = defaultdict(float)
+            for m in self.state.available_map().values():
+                for k, v in m.items():
+                    agg[k] += v
+            return dict(agg)
+
+    def rpc_list_tasks(self, p, conn):
+        with self._lock:
+            return list(self.task_events)[-int(p.get("limit", 1000)):]
+
+    def rpc_list_actors(self, p, conn):
+        with self._lock:
+            return [
+                {k: a.get(k) for k in ("actor_id", "state", "node_id", "class_name", "name")}
+                for a in self.actors.values()
+            ]
+
+    def rpc_summary(self, p, conn):
+        with self._lock:
+            return {
+                "nodes_alive": sum(1 for n in self.nodes.values() if n["alive"]),
+                "nodes_dead": sum(1 for n in self.nodes.values() if not n["alive"]),
+                "tasks_pending": len(self.pending),
+                "tasks_running": len(self.running),
+                "actors": len(self.actors),
+                "placement_groups": len(self.placement_groups),
+            }
+
+    # ------------------------------------------------------- placement groups
+
+    def rpc_create_placement_group(self, p, conn):
+        """2-phase commit against node daemons (reference:
+        gcs_placement_group_scheduler.cc Prepare/CommitBundleResources)."""
+        pg_id = p["pg_id"]
+        bundles = p["bundles"]  # list of {resource: amount}
+        strategy = p.get("strategy", "PACK")
+        with self._lock:
+            mat = np.stack([self.space.vector(b) for b in bundles])
+            nodes_idx, new_avail = bundles_mod.schedule_bundles(
+                self.state.available, self.state.total, self.state.alive,
+                mat, strategy=strategy,
+            )
+            if nodes_idx is None:
+                self.placement_groups[pg_id] = {
+                    "pg_id": pg_id, "state": "PENDING", "bundles": bundles,
+                    "strategy": strategy, "nodes": None,
+                }
+                return {"ok": False, "state": "PENDING"}
+            self.state.available = new_avail
+            node_ids = [self.state.node_ids[i] for i in nodes_idx]
+            self.placement_groups[pg_id] = {
+                "pg_id": pg_id, "state": "CREATED", "bundles": bundles,
+                "strategy": strategy, "nodes": node_ids,
+            }
+        # phase 2: commit bundle reservations on daemons (best-effort v1;
+        # resources are authoritative here, daemons just learn the mapping)
+        for b_idx, nid in enumerate(node_ids):
+            self._push_to_node(nid, "commit_bundle", {
+                "pg_id": pg_id, "bundle_index": b_idx, "resources": bundles[b_idx],
+            })
+        return {"ok": True, "state": "CREATED", "nodes": node_ids}
+
+    def rpc_remove_placement_group(self, p, conn):
+        with self._lock:
+            pg = self.placement_groups.pop(p["pg_id"], None)
+            if pg and pg.get("nodes"):
+                for b, nid in zip(pg["bundles"], pg["nodes"]):
+                    idx = self.state.node_index(nid)
+                    if idx is not None and self.state.alive[idx]:
+                        self.state.release(idx, self.space.vector(b))
+        self._kick()
+        return {"ok": True}
+
+    def rpc_get_placement_group(self, p, conn):
+        with self._lock:
+            pg = self.placement_groups.get(p["pg_id"])
+            if pg is None:
+                return None
+            return dict(pg)
+
+    # ------------------------------------------------------------- scheduler
+
+    def _kick(self):
+        with self._sched_cv:
+            self._sched_cv.notify()
+
+    def _sched_loop(self):
+        interval = self.config.scheduler_round_interval_ms / 1000.0
+        while not self._stopped:
+            with self._sched_cv:
+                self._sched_cv.wait(timeout=interval)
+            try:
+                self._schedule_round()
+            except Exception:
+                traceback.print_exc()
+
+    def _schedule_round(self):
+        """Reference hot path reformulated: the whole queue -> one batched
+        kernel call -> dispatch pushes to daemons."""
+        with self._lock:
+            if not self.pending:
+                return
+            batch = list(self.pending)
+            self.pending.clear()
+
+            # split off strategy-constrained tasks (node affinity / PG bundle)
+            default_batch, special = [], []
+            for t in batch:
+                if t.get("strategy", {}).get("kind") in ("NODE_AFFINITY", "PLACEMENT_GROUP"):
+                    special.append(t)
+                else:
+                    default_batch.append(t)
+
+            classes: Dict[Tuple, List[dict]] = defaultdict(list)
+            for t in default_batch:
+                classes[t["class_key"]].append(t)
+            leftovers: List[dict] = []
+            if classes:
+                keys = list(classes.keys())
+                demands = np.stack(
+                    [self.space.vector(classes[k][0]["resources"]) for k in keys]
+                )
+                counts = np.array([len(classes[k]) for k in keys], dtype=np.int32)
+                assigned = self.policy.schedule(self.state, demands, counts)
+                dispatches = []
+                for c, key in enumerate(keys):
+                    specs = list(classes[key])
+                    si = 0
+                    for n in np.flatnonzero(assigned[c]):
+                        for _ in range(int(assigned[c][n])):
+                            if si >= len(specs):
+                                break
+                            dispatches.append((specs[si], int(n), demands[c]))
+                            si += 1
+                    leftovers.extend(specs[si:])
+            else:
+                dispatches = []
+
+            failed: List[tuple] = []
+            for t in special:
+                kind, payload = self._schedule_special(t)
+                if kind == "dispatch":
+                    dispatches.append(payload)
+                elif kind == "fail":
+                    failed.append((t, payload))
+                else:
+                    leftovers.append(t)
+
+            # retry PENDING placement groups now that resources may have
+            # freed up (reference: SchedulePendingPlacementGroups loop)
+            self._retry_pending_pgs()
+
+            self.pending.extend(leftovers)
+            for t, node_idx, demand in dispatches:
+                node_id = self.state.node_ids[node_idx]
+                self.running[t["task_id"]] = {
+                    "node_id": node_id,
+                    "demand": demand,
+                    "owner_conn": t["owner_conn"],
+                    "meta": t,
+                }
+                if t.get("actor_creation"):
+                    aid = t.get("actor_id")
+                    if aid in self.actors:
+                        self.actors[aid]["node_id"] = node_id
+                        self.actors[aid]["state"] = "STARTING"
+
+            to_push = [
+                (self.running[t["task_id"]]["node_id"], t) for t, _, _ in dispatches
+            ]
+        for node_id, t in to_push:
+            self._push_to_node(node_id, "exec_task", t)
+        for t, reason in failed:
+            target = self._driver_conn(t.get("owner_conn"))
+            if target is not None:
+                payload = {"task_id": t["task_id"], "status": "UNSCHEDULABLE",
+                           "error": reason}
+                self.server.call_soon(
+                    lambda tg=target, pl=payload: __import__("asyncio").ensure_future(
+                        tg.push("task_result", pl)
+                    )
+                )
+
+    def _schedule_special(self, t) -> Tuple[str, Any]:
+        """NODE_AFFINITY and PLACEMENT_GROUP strategies (reference:
+        node_affinity_scheduling_policy.cc, affinity_with_bundle_...).
+        Returns ("dispatch", (t, node_idx, demand)) | ("requeue", None) |
+        ("fail", reason)."""
+        strat = t.get("strategy", {})
+        demand = self.space.vector(t["resources"])
+        if strat.get("kind") == "NODE_AFFINITY":
+            target = strat.get("node_id")
+            idx = self.state.node_index(target)
+            node_dead = idx is None or not self.state.alive[idx]
+            if idx is not None and not node_dead and self.state.allocate(idx, demand):
+                return ("dispatch", (t, idx, demand))
+            if strat.get("soft"):
+                # fall back to any feasible node
+                from ray_tpu.sched import kernel_np
+
+                feas = kernel_np.feasible_mask(
+                    self.state.available, self.state.alive, demand
+                )
+                if feas.any():
+                    idx = int(np.argmax(feas))
+                    if self.state.allocate(idx, demand):
+                        return ("dispatch", (t, idx, demand))
+                return ("requeue", None)
+            if node_dead:
+                # hard affinity to a dead/unknown node can never succeed
+                return ("fail", f"node {target} is dead or unknown "
+                                f"(hard NodeAffinity)")
+            return ("requeue", None)
+        if strat.get("kind") == "PLACEMENT_GROUP":
+            pg = self.placement_groups.get(strat.get("placement_group_id"))
+            if pg is None:
+                return ("fail", f"placement group "
+                                f"{strat.get('placement_group_id')} does not exist")
+            if pg["state"] != "CREATED":
+                return ("requeue", None)
+            b_idx = strat.get("bundle_index", -1)
+            candidates = (
+                [pg["nodes"][b_idx]] if 0 <= b_idx < len(pg["nodes"]) else pg["nodes"]
+            )
+            for nid in candidates:
+                idx = self.state.node_index(nid)
+                # PG bundles already hold their resources; task rides inside
+                # the bundle reservation, so no extra allocation (v1 model).
+                if idx is not None and self.state.alive[idx]:
+                    return ("dispatch", (t, idx, self.space.vector({})))
+            return ("requeue", None)
+        return ("requeue", None)
+
+    def _retry_pending_pgs(self):
+        """Called under self._lock from the scheduler round."""
+        for pg_id, pg in self.placement_groups.items():
+            if pg["state"] != "PENDING":
+                continue
+            mat = np.stack([self.space.vector(b) for b in pg["bundles"]])
+            nodes_idx, new_avail = bundles_mod.schedule_bundles(
+                self.state.available, self.state.total, self.state.alive,
+                mat, strategy=pg["strategy"],
+            )
+            if nodes_idx is None:
+                continue
+            self.state.available = new_avail
+            node_ids = [self.state.node_ids[i] for i in nodes_idx]
+            pg["state"] = "CREATED"
+            pg["nodes"] = node_ids
+            for b_idx, nid in enumerate(node_ids):
+                self._push_to_node(nid, "commit_bundle", {
+                    "pg_id": pg_id, "bundle_index": b_idx,
+                    "resources": pg["bundles"][b_idx],
+                })
+
+    def _push_to_node(self, node_id: str, channel: str, data):
+        with self._lock:
+            n = self.nodes.get(node_id)
+            conn = None
+            if n and n["alive"]:
+                for c in self.server.conns.values():
+                    if c.conn_id == n["conn_id"]:
+                        conn = c
+                        break
+        if conn is not None:
+            self.server.call_soon(
+                lambda: __import__("asyncio").ensure_future(conn.push(channel, data))
+            )
+
+    # ---------------------------------------------------------- failure path
+
+    def _on_disconnect(self, conn):
+        node_id = conn.meta.get("node_id")
+        driver_id = conn.meta.get("driver_id")
+        if node_id:
+            self._mark_node_dead(node_id, "daemon connection lost")
+        if driver_id:
+            with self._lock:
+                self.drivers.pop(conn.conn_id, None)
+                if driver_id in self.jobs:
+                    self.jobs[driver_id]["state"] = "FINISHED"
+
+    def _health_loop(self):
+        period = self.config.health_check_period_ms / 1000.0
+        timeout = self.config.health_check_timeout_ms / 1000.0
+        while not self._stopped:
+            time.sleep(period)
+            now = time.time()
+            dead = []
+            with self._lock:
+                for nid, n in self.nodes.items():
+                    if n["alive"] and now - n["last_beat"] > timeout:
+                        dead.append(nid)
+            for nid in dead:
+                self._mark_node_dead(nid, "heartbeat timeout")
+
+    def _mark_node_dead(self, node_id: str, cause: str):
+        """Reference: GcsNodeManager::OnNodeFailure — broadcast death, fail
+        running tasks (owners retry / reconstruct), restart actors."""
+        with self._lock:
+            n = self.nodes.get(node_id)
+            if not n or not n["alive"]:
+                return
+            n["alive"] = False
+            self.state.remove_node(node_id)
+            lost_tasks = [
+                (tid, info) for tid, info in self.running.items()
+                if info["node_id"] == node_id
+            ]
+            for tid, _ in lost_tasks:
+                self.running.pop(tid, None)
+            # objects on the node are gone from the directory
+            for oid, nodes in list(self.directory.items()):
+                nodes.discard(node_id)
+            dead_actors = [
+                a for a in self.actors.values()
+                if a["node_id"] == node_id and a["state"] in ("ALIVE", "STARTING")
+            ]
+            for a in dead_actors:
+                a["state"] = "DEAD"
+                a["death_cause"] = f"node {node_id} died: {cause}"
+            self._publish_nodes()
+        for tid, info in lost_tasks:
+            target = self._driver_conn(info["owner_conn"])
+            if target is not None:
+                payload = {
+                    "task_id": tid, "status": "NODE_DIED", "node_id": node_id,
+                    "error": f"node {node_id} died: {cause}",
+                }
+                self.server.call_soon(
+                    lambda t=target, pl=payload: __import__("asyncio").ensure_future(
+                        t.push("task_result", pl)
+                    )
+                )
+        for a in dead_actors:
+            self.server.broadcast(
+                "actor_update", {"actor_id": a["actor_id"], "state": "DEAD"}
+            )
+        self._kick()
+
+    def _publish_nodes(self):
+        snapshot = {
+            nid: {k: n[k] for k in ("addr", "port", "resources", "alive")}
+            for nid, n in self.nodes.items()
+        }
+        self.server.broadcast("nodes", snapshot)
+
+    def shutdown(self):
+        self._stopped = True
+        self._kick()
+        self.server.stop()

@@ -1,0 +1,588 @@
+"""Per-node daemon: worker pool + local object store + task execution.
+
+Reference: the raylet (src/ray/raylet/) — main.cc/node_manager.cc wiring
+WorkerPool (worker_pool.cc: PopWorker/StartWorkerProcess), the local object
+store (object_manager/plasma/ — in-process here until the C++ shm store
+lands), object transfer (object_manager.cc push/pull in chunks), and local
+spilling (local_object_manager.cc).
+
+Scheduling does NOT live here (centralized batched rounds in the GCS — see
+cluster/__init__.py); the daemon executes `exec_task` pushes, which is the
+lease-grant + dispatch half of the reference's
+LocalTaskManager::DispatchScheduledTasksToWorkers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import subprocess
+import sys
+import threading
+import time
+import traceback
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.core.config import Config
+from ray_tpu.core.task_spec import new_id
+from ray_tpu.cluster.rpc import RpcClient, RpcServer
+
+
+class ObjectStore:
+    """Node-local object store: packed payload bytes by object id, LRU
+    spilling to disk when over budget (reference: plasma + local_object_manager
+    spilling). Thread-safe; blocking get with timeout."""
+
+    def __init__(self, capacity_bytes: int, spill_dir: str):
+        self.capacity = capacity_bytes
+        self.spill_dir = spill_dir
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._data: Dict[str, bytes] = {}
+        self._spilled: Dict[str, str] = {}
+        self._lru: deque = deque()
+        self._size = 0
+
+    def put(self, oid: str, payload: bytes) -> None:
+        with self._cv:
+            if oid in self._data or oid in self._spilled:
+                return
+            self._data[oid] = payload
+            self._size += len(payload)
+            self._lru.append(oid)
+            self._maybe_spill()
+            self._cv.notify_all()
+
+    def _maybe_spill(self):
+        while self._size > self.capacity and self._lru:
+            victim = self._lru.popleft()
+            payload = self._data.pop(victim, None)
+            if payload is None:
+                continue
+            os.makedirs(self.spill_dir, exist_ok=True)
+            path = os.path.join(self.spill_dir, victim)
+            with open(path, "wb") as f:
+                f.write(payload)
+            self._spilled[victim] = path
+            self._size -= len(payload)
+
+    def get(self, oid: str, timeout: Optional[float] = None) -> Optional[bytes]:
+        deadline = None if timeout is None else time.time() + timeout
+        with self._cv:
+            while True:
+                if oid in self._data:
+                    try:
+                        self._lru.remove(oid)
+                    except ValueError:
+                        pass
+                    self._lru.append(oid)
+                    return self._data[oid]
+                if oid in self._spilled:
+                    path = self._spilled[oid]
+                    break
+                if deadline is None or time.time() >= deadline:
+                    return None
+                self._cv.wait(timeout=min(0.1, max(0.0, deadline - time.time())))
+        with open(path, "rb") as f:  # restore outside the lock
+            payload = f.read()
+        with self._cv:
+            if oid in self._spilled:
+                del self._spilled[oid]
+                self._data[oid] = payload
+                self._size += len(payload)
+                self._lru.append(oid)
+                self._maybe_spill()
+            unlink = oid not in self._spilled  # may have re-spilled to same path
+        if unlink:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        return payload
+
+    def contains(self, oid: str) -> bool:
+        with self._lock:
+            return oid in self._data or oid in self._spilled
+
+    def delete(self, oids: List[str]):
+        with self._cv:
+            for oid in oids:
+                payload = self._data.pop(oid, None)
+                if payload is not None:
+                    self._size -= len(payload)
+                    try:
+                        self._lru.remove(oid)
+                    except ValueError:
+                        pass
+                path = self._spilled.pop(oid, None)
+                if path:
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "objects": len(self._data) + len(self._spilled),
+                "bytes_in_memory": self._size,
+                "spilled": len(self._spilled),
+            }
+
+
+class _Worker:
+    def __init__(self, worker_id: str, proc: subprocess.Popen):
+        self.worker_id = worker_id
+        self.proc = proc
+        self.conn = None  # ServerConn once registered
+        self.busy = False
+        self.actor_id: Optional[str] = None
+        self.current_task: Optional[dict] = None
+
+
+class NodeDaemon:
+    def __init__(
+        self,
+        gcs_addr,
+        resources: Dict[str, float],
+        node_id: Optional[str] = None,
+        config: Optional[Config] = None,
+        host: str = "127.0.0.1",
+        labels: Optional[Dict[str, str]] = None,
+    ):
+        self.config = config or Config()
+        self.node_id = node_id or new_id("node")
+        self.resources = dict(resources)
+        self.host = host
+        spill_root = self.config.object_spilling_dir or os.path.join(
+            self.config.session_dir_root, "spill", self.node_id
+        )
+        self.store = ObjectStore(self.config.object_store_memory_bytes, spill_root)
+
+        self._lock = threading.Lock()
+        self.workers: Dict[str, _Worker] = {}
+        self._idle: deque = deque()
+        self._task_queue: deque = deque()  # tasks waiting for a worker
+        self._actor_tasks: Dict[str, dict] = {}  # task_id -> meta (actor rpc futures)
+        self._pending_rpc: Dict[str, Any] = {}  # task_id -> asyncio future (actor calls)
+        self._peer_clients: Dict[str, RpcClient] = {}
+        self._bundles: Dict[str, dict] = {}
+
+        self.server = RpcServer(
+            self._handle, host=host, port=0,
+            on_disconnect=self._on_worker_disconnect, name=f"daemon-{self.node_id[:8]}",
+        )
+        self.port = self.server.start()
+
+        self.gcs = RpcClient(gcs_addr[0], gcs_addr[1])
+        self.gcs.subscribe("exec_task", self._on_exec_task)
+        self.gcs.subscribe("kill_actor", self._on_kill_actor)
+        self.gcs.subscribe("free_objects", lambda p: self.store.delete(p["object_ids"]))
+        self.gcs.subscribe("commit_bundle", self._on_commit_bundle)
+        self.gcs.subscribe("nodes", self._on_nodes_update)
+        self._nodes_snapshot: Dict[str, dict] = {}
+        reply = self.gcs.call("register_node", {
+            "node_id": self.node_id, "addr": host, "port": self.port,
+            "resources": resources, "labels": labels or {},
+        })
+        assert reply["ok"]
+        self._stopped = False
+        self._beat_thread = threading.Thread(
+            target=self._heartbeat_loop, daemon=True, name="daemon-beat"
+        )
+        self._beat_thread.start()
+
+    # ------------------------------------------------------------ worker pool
+
+    def _spawn_worker(self) -> _Worker:
+        worker_id = new_id("worker")
+        env = dict(os.environ)
+        env["RAY_TPU_DAEMON_PORT"] = str(self.port)
+        env["RAY_TPU_DAEMON_HOST"] = self.host
+        env["RAY_TPU_WORKER_ID"] = worker_id
+        env["RAY_TPU_NODE_ID"] = self.node_id
+        env["RAY_TPU_GCS_ADDR"] = f"{self.gcs.host}:{self.gcs.port}"
+        env["PYTHONPATH"] = (
+            os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+            + os.pathsep + env.get("PYTHONPATH", "")
+        )
+        # Workers default to CPU jax so N workers don't fight over the one
+        # TPU chip; tasks demanding TPU get it via RAY_TPU_WORKER_USE_TPU.
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.cluster.worker"],
+            env=env,
+            stdout=subprocess.DEVNULL if not self.config.log_to_driver else None,
+            stderr=None,
+        )
+        w = _Worker(worker_id, proc)
+        with self._lock:
+            self.workers[worker_id] = w
+        return w
+
+    def _on_worker_disconnect(self, conn):
+        worker_id = conn.meta.get("worker_id")
+        if not worker_id:
+            return
+        with self._lock:
+            w = self.workers.pop(worker_id, None)
+            try:
+                self._idle.remove(worker_id)
+            except ValueError:
+                pass
+        if w and w.current_task:
+            # worker crashed mid-task -> report failure (reference:
+            # NodeManager worker death handling -> task failure)
+            t = w.current_task
+            self._report_done(t, status="WORKER_DIED",
+                             error=f"worker {worker_id} died (exit {w.proc.poll()})")
+        if w and w.actor_id:
+            # resolve every in-flight actor call on this worker, else the
+            # drivers' actor_call rpcs hang forever
+            stranded = [
+                t for t in list(self._actor_tasks.values())
+                if t.get("actor_id") == w.actor_id
+            ]
+            for t in stranded:
+                self._actor_tasks.pop(t["task_id"], None)
+                self._report_done(
+                    t, status="ACTOR_DEAD",
+                    error=f"actor worker died (exit {w.proc.poll()})",
+                )
+            try:
+                self.gcs.call("actor_died", {
+                    "actor_id": w.actor_id,
+                    "cause": f"worker process died (exit {w.proc.poll()})",
+                })
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------------ rpc
+
+    def _handle(self, method, params, conn):
+        fn = getattr(self, f"rpc_{method}", None)
+        if fn is None:
+            raise ValueError(f"unknown daemon method {method}")
+        return fn(params or {}, conn)
+
+    def rpc_worker_ready(self, p, conn):
+        worker_id = p["worker_id"]
+        conn.meta["worker_id"] = worker_id
+        with self._lock:
+            w = self.workers.get(worker_id)
+            if w is None:
+                w = _Worker(worker_id, proc=None)
+                self.workers[worker_id] = w
+            w.conn = conn
+            self._idle.append(worker_id)
+        self._pump()
+        return {"ok": True, "node_id": self.node_id}
+
+    def rpc_task_finished(self, p, conn):
+        """Worker -> daemon: results arrive as packed payload bytes."""
+        for oid, payload in p.get("result_payloads", {}).items():
+            self.store.put(oid, payload)
+        worker_id = conn.meta.get("worker_id")
+        # actor calls are tracked by task id (several can be in flight on one
+        # worker); pool tasks by the worker's current_task slot
+        t = self._actor_tasks.pop(p["task_id"], None)
+        with self._lock:
+            w = self.workers.get(worker_id)
+            if w is not None and t is None and w.current_task is not None \
+                    and w.current_task["task_id"] == p["task_id"]:
+                t = w.current_task
+            if w is not None and t is not None and w.current_task is t:
+                w.current_task = None
+            if w is not None and w.actor_id is None and w.current_task is None:
+                w.busy = False
+                self._idle.append(worker_id)
+        if t is not None:
+            self._report_done(
+                t, status=p.get("status", "FINISHED"), error=p.get("error"),
+                results=[(oid, len(pl)) for oid, pl in p.get("result_payloads", {}).items()],
+                start=p.get("start"), end=p.get("end"),
+            )
+        self._pump()
+        return {"ok": True}
+
+    def rpc_get_object(self, p, conn):
+        """Workers/drivers resolve objects through the daemon: local store
+        hit, else locate via GCS directory + pull from the peer daemon
+        (reference: pull_manager.cc / ObjectManager chunked pull). Runs on
+        the thread pool — blocking here would stall the daemon's event loop
+        (and with it task_finished handling: a same-node producer could then
+        never publish the object being waited on)."""
+        return self.server.loop.run_in_executor(
+            None,
+            lambda: self._get_object_bytes(p["object_id"], timeout=p.get("timeout", 30.0)),
+        )
+
+    def rpc_fetch_object(self, p, conn):
+        """Peer daemons / drivers fetch a locally-stored object."""
+        timeout = p.get("timeout", 0.0)
+        if timeout <= 0:
+            return self.store.get(p["object_id"], timeout=0.0)
+        return self.server.loop.run_in_executor(
+            None, lambda: self.store.get(p["object_id"], timeout=timeout)
+        )
+
+    def rpc_put_object(self, p, conn):
+        self.store.put(p["object_id"], p["payload"])
+        try:
+            self.gcs.call("add_object_location", {
+                "object_id": p["object_id"], "node_id": self.node_id,
+            })
+        except Exception:
+            pass
+        return {"ok": True}
+
+    def rpc_actor_call(self, p, conn):
+        """Driver -> daemon: run an actor method, await completion (the rpc
+        response carries the result metadata; payloads go through the store)."""
+        fut = self.server.loop.create_future()
+        self._pending_rpc[p["task_id"]] = fut
+        self._dispatch_actor_task(p)
+        return fut
+
+    def rpc_stats(self, p, conn):
+        with self._lock:
+            return {
+                "node_id": self.node_id,
+                "workers": len(self.workers),
+                "idle": len(self._idle),
+                "queued": len(self._task_queue),
+                "store": self.store.stats(),
+            }
+
+    # --------------------------------------------------------- task dispatch
+
+    def _on_exec_task(self, t: dict):
+        with self._lock:
+            self._task_queue.append(t)
+        self._pump()
+
+    def _pump(self):
+        """Match queued tasks to idle workers; spawn when the pool is short
+        (reference: WorkerPool::PopWorker + StartWorkerProcess prestart)."""
+        while True:
+            with self._lock:
+                if not self._task_queue:
+                    return
+                if self._idle:
+                    worker_id = self._idle.popleft()
+                    w = self.workers.get(worker_id)
+                    if w is None or w.conn is None:
+                        continue
+                    t = self._task_queue.popleft()
+                    w.busy = True
+                    w.current_task = t
+                    if t.get("actor_creation"):
+                        w.actor_id = t.get("actor_id")
+                    conn = w.conn
+                else:
+                    limit = self.config.num_workers_soft_limit or max(
+                        int(self.resources.get("CPU", 4)) + 2, 4
+                    )
+                    if len(self.workers) < limit + sum(
+                        1 for w in self.workers.values() if w.actor_id
+                    ):
+                        spawn = True
+                    else:
+                        spawn = False
+                    t = None
+            if t is None:
+                if spawn:
+                    self._spawn_worker()
+                return
+            self.server.call_soon(
+                lambda c=conn, task=t: asyncio.ensure_future(c.push("run_task", task))
+            )
+
+    def _dispatch_actor_task(self, t: dict):
+        aid = t["actor_id"]
+        with self._lock:
+            w = next(
+                (w for w in self.workers.values() if w.actor_id == aid), None
+            )
+        if w is None or w.conn is None:
+            fut = self._pending_rpc.pop(t["task_id"], None)
+            if fut is not None:
+                self.server.call_soon(
+                    lambda: fut.set_result({
+                        "status": "ACTOR_DEAD", "task_id": t["task_id"],
+                        "node_id": self.node_id, "results": [], "inline": {},
+                        "error": f"actor {aid} not on node {self.node_id}",
+                    }) if not fut.done() else None
+                )
+            return
+        self._actor_tasks[t["task_id"]] = t
+        self.server.call_soon(
+            lambda c=w.conn, task=t: asyncio.ensure_future(c.push("run_task", task))
+        )
+
+    def _report_done(self, t: dict, status: str, error=None, results=None,
+                     start=None, end=None):
+        task_id = t["task_id"]
+        fut = self._pending_rpc.pop(task_id, None)
+        payload = {
+            "task_id": task_id,
+            "node_id": self.node_id,
+            "status": status,
+            "error": error,
+            "results": results or [],
+            "name": t.get("name"),
+            "actor_id": t.get("actor_id"),
+            "actor_creation": t.get("actor_creation", False),
+            "owner_conn": t.get("owner_conn"),
+            "start": start,
+            "end": end,
+        }
+        # inline small results so the driver skips the fetch round trip
+        inline = {}
+        budget = self.config.max_direct_call_object_size
+        for oid, size in payload["results"]:
+            if size <= budget:
+                data = self.store.get(oid, timeout=0.1)
+                if data is not None:
+                    inline[oid] = data
+        payload["inline"] = inline
+        if fut is not None:  # actor call: answer the driver rpc directly
+            self.server.call_soon(
+                lambda: fut.set_result(payload) if not fut.done() else None
+            )
+            self._actor_tasks.pop(task_id, None)
+            for oid, _ in payload["results"]:
+                try:
+                    self.gcs.call("add_object_location", {
+                        "object_id": oid, "node_id": self.node_id,
+                    })
+                except Exception:
+                    pass
+            return
+        try:
+            self.gcs.call("task_done", payload)
+        except Exception:
+            traceback.print_exc()
+
+    # ------------------------------------------------------------- transfers
+
+    def _get_object_bytes(self, oid: str, timeout: float) -> Optional[bytes]:
+        payload = self.store.get(oid, timeout=0.0)
+        if payload is not None:
+            return payload
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            try:
+                loc = self.gcs.call("locate_object", {"object_id": oid})
+            except Exception:
+                return None
+            for entry in loc.get("nodes", []):
+                if entry["node_id"] == self.node_id:
+                    continue
+                peer = self._peer(entry["node_id"], entry["addr"], entry["port"])
+                if peer is None:
+                    continue
+                try:
+                    payload = peer.call(
+                        "fetch_object", {"object_id": oid, "timeout": 5.0},
+                        timeout=30.0,
+                    )
+                except Exception:
+                    payload = None
+                if payload is not None:
+                    self.store.put(oid, payload)
+                    try:
+                        self.gcs.call("add_object_location", {
+                            "object_id": oid, "node_id": self.node_id,
+                        })
+                    except Exception:
+                        pass
+                    return payload
+            # object may be produced by an in-flight task: wait for local
+            payload = self.store.get(oid, timeout=0.2)
+            if payload is not None:
+                return payload
+        return None
+
+    def _peer(self, node_id, addr, port) -> Optional[RpcClient]:
+        with self._lock:
+            c = self._peer_clients.get(node_id)
+            if c is not None and not c._closed:
+                return c
+        try:
+            c = RpcClient(addr, port)
+        except OSError:
+            return None
+        with self._lock:
+            self._peer_clients[node_id] = c
+        return c
+
+    # ----------------------------------------------------------------- misc
+
+    def _on_kill_actor(self, p):
+        aid = p["actor_id"]
+        with self._lock:
+            w = next((w for w in self.workers.values() if w.actor_id == aid), None)
+        if w is not None and w.proc is not None:
+            try:
+                w.proc.terminate()
+            except OSError:
+                pass
+
+    def _on_commit_bundle(self, p):
+        # Reference: placement_group_resource_manager.cc mints
+        # CPU_group_<pgid> resources; v1 records the reservation (resource
+        # authority is the GCS view).
+        self._bundles[f"{p['pg_id']}:{p['bundle_index']}"] = p
+
+    def _on_nodes_update(self, snapshot):
+        self._nodes_snapshot = snapshot
+
+    def _heartbeat_loop(self):
+        period = self.config.health_check_period_ms / 1000.0
+        while not self._stopped:
+            try:
+                self.gcs.call("heartbeat", {"node_id": self.node_id}, timeout=5.0)
+            except Exception:
+                pass
+            time.sleep(period)
+
+    def shutdown(self):
+        self._stopped = True
+        with self._lock:
+            workers = list(self.workers.values())
+        for w in workers:
+            if w.proc is not None:
+                try:
+                    w.proc.terminate()
+                except OSError:
+                    pass
+        self.server.stop()
+        self.gcs.close()
+
+
+def main():  # pragma: no cover - exercised as a subprocess
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--gcs-host", required=True)
+    ap.add_argument("--gcs-port", type=int, required=True)
+    ap.add_argument("--resources", required=True, help="JSON resource map")
+    ap.add_argument("--node-id", default=None)
+    args = ap.parse_args()
+    daemon = NodeDaemon(
+        (args.gcs_host, args.gcs_port),
+        json.loads(args.resources),
+        node_id=args.node_id,
+    )
+    print(f"daemon {daemon.node_id} on port {daemon.port}", flush=True)
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        daemon.shutdown()
+
+
+if __name__ == "__main__":
+    main()

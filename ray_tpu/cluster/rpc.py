@@ -1,0 +1,360 @@
+"""Dependency-free RPC: length-prefixed pickle frames over asyncio TCP.
+
+Fills the role of the reference's gRPC layer (src/ray/rpc/grpc_server.h,
+grpc_client.h, retryable_grpc_client.cc) for the host-side control plane.
+The environment has no grpcio; the control plane is low-rate (the data plane
+moves bytes in chunks over the same framing), so asyncio + pickle is enough.
+
+Frame: 8-byte little-endian length + pickle payload.
+Request: {"id": n, "method": str, "params": obj}
+Response: {"id": n, "result": obj} | {"id": n, "error": (type_name, str, tb)}
+Push (server->client, no id): {"push": channel, "data": obj}
+"""
+
+from __future__ import annotations
+
+import asyncio
+import pickle
+import socket
+import struct
+import threading
+import traceback
+from typing import Any, Callable, Dict, Optional
+
+_LEN = struct.Struct("<Q")
+MAX_FRAME = 1 << 31
+
+
+class RpcError(Exception):
+    pass
+
+
+class ConnectionLost(RpcError):
+    pass
+
+
+async def read_frame(reader: asyncio.StreamReader) -> Any:
+    header = await reader.readexactly(_LEN.size)
+    (n,) = _LEN.unpack(header)
+    if n > MAX_FRAME:
+        raise RpcError(f"frame too large: {n}")
+    data = await reader.readexactly(n)
+    return pickle.loads(data)
+
+
+def frame_bytes(obj: Any) -> bytes:
+    data = pickle.dumps(obj, protocol=5)
+    return _LEN.pack(len(data)) + data
+
+
+async def write_frame(writer: asyncio.StreamWriter, obj: Any):
+    writer.write(frame_bytes(obj))
+    await writer.drain()
+
+
+class ServerConn:
+    """One accepted connection; supports push."""
+
+    _next_id = 0
+
+    def __init__(self, reader, writer, loop):
+        self.reader = reader
+        self.writer = writer
+        self.loop = loop
+        ServerConn._next_id += 1
+        self.conn_id = ServerConn._next_id
+        self.meta: Dict[str, Any] = {}  # handler scratch (e.g. node_id)
+        self._wlock = asyncio.Lock()
+        self.closed = False
+
+    async def push(self, channel: str, data: Any):
+        if self.closed:
+            return
+        try:
+            async with self._wlock:
+                await write_frame(self.writer, {"push": channel, "data": data})
+        except (ConnectionError, asyncio.IncompleteReadError, RuntimeError):
+            self.closed = True
+
+    async def respond(self, msg: dict):
+        try:
+            async with self._wlock:
+                await write_frame(self.writer, msg)
+        except (ConnectionError, RuntimeError):
+            self.closed = True
+
+
+class RpcServer:
+    """Asyncio TCP server running in its own thread.
+
+    handler(method, params, conn) -> result (sync or async); raising maps to
+    an error response. on_disconnect(conn) fires when a client drops — the
+    hook health-checking builds on (reference: gcs_health_check_manager.cc
+    polls; we get edge-triggered close + periodic heartbeats).
+    """
+
+    def __init__(
+        self,
+        handler: Callable,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        on_disconnect: Optional[Callable] = None,
+        name: str = "rpc",
+    ):
+        self.handler = handler
+        self.on_disconnect = on_disconnect
+        self.host = host
+        self.port = port
+        self.name = name
+        self.loop = asyncio.new_event_loop()
+        self._started = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name=f"{name}-server", daemon=True
+        )
+        self.conns: Dict[int, ServerConn] = {}
+        self._server = None
+
+    def start(self) -> int:
+        self._thread.start()
+        if not self._started.wait(timeout=10):
+            raise RpcError("server failed to start")
+        return self.port
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_until_complete(self._serve())
+        try:
+            self.loop.run_forever()
+        finally:
+            self.loop.close()
+
+    async def _serve(self):
+        self._server = await asyncio.start_server(
+            self._on_client, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        self._started.set()
+
+    async def _on_client(self, reader, writer):
+        conn = ServerConn(reader, writer, self.loop)
+        self.conns[conn.conn_id] = conn
+        try:
+            while True:
+                msg = await read_frame(reader)
+                asyncio.ensure_future(self._dispatch(conn, msg))
+        except (
+            asyncio.IncompleteReadError,
+            ConnectionError,
+            pickle.UnpicklingError,
+            EOFError,
+        ):
+            pass
+        finally:
+            conn.closed = True
+            self.conns.pop(conn.conn_id, None)
+            if self.on_disconnect:
+                try:
+                    res = self.on_disconnect(conn)
+                    if asyncio.iscoroutine(res):
+                        await res
+                except Exception:
+                    traceback.print_exc()
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _dispatch(self, conn: ServerConn, msg: dict):
+        mid = msg.get("id")
+        try:
+            result = self.handler(msg["method"], msg.get("params"), conn)
+            if asyncio.iscoroutine(result) or isinstance(result, asyncio.Future):
+                result = await result
+            if mid is not None:
+                await conn.respond({"id": mid, "result": result})
+        except Exception as e:
+            if mid is not None:
+                await conn.respond(
+                    {"id": mid, "error": (type(e).__name__, str(e), traceback.format_exc())}
+                )
+            else:
+                traceback.print_exc()
+
+    def broadcast(self, channel: str, data: Any, filter_fn=None):
+        """Thread-safe push to all (or filtered) connections."""
+
+        def _do():
+            for conn in list(self.conns.values()):
+                if filter_fn is None or filter_fn(conn):
+                    asyncio.ensure_future(conn.push(channel, data))
+
+        try:
+            self.loop.call_soon_threadsafe(_do)
+        except RuntimeError:  # loop closed during shutdown
+            pass
+
+    def call_soon(self, fn, *args):
+        try:
+            self.loop.call_soon_threadsafe(fn, *args)
+        except RuntimeError:  # loop closed during shutdown
+            pass
+
+    def stop(self):
+        def _stop():
+            if self._server:
+                self._server.close()
+            self.loop.stop()
+
+        try:
+            self.loop.call_soon_threadsafe(_stop)
+            self._thread.join(timeout=3)
+        except Exception:
+            pass
+
+
+class RpcClient:
+    """Synchronous client facade over a background asyncio connection.
+
+    call() blocks the calling thread; subscriptions deliver on a dedicated
+    dispatch thread (so callbacks may themselves call()). Reconnection is NOT
+    automatic — the owner decides (reference: retryable_grpc_client retries;
+    our daemons treat a lost GCS conn as fatal-until-restart for v1).
+    """
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        from concurrent.futures import Future
+
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+        self._next_id = 0
+        self._id_lock = threading.Lock()
+        self._pending: Dict[int, "Future"] = {}
+        self._subs: Dict[str, Callable] = {}
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._sock.settimeout(None)
+        self._send_lock = threading.Lock()
+        self._closed = False
+        self.on_close: Optional[Callable] = None
+        self._reader_thread = threading.Thread(
+            target=self._read_loop, daemon=True, name="rpc-client-reader"
+        )
+        self._reader_thread.start()
+
+    def _read_loop(self):
+        buf = b""
+        sock = self._sock
+        try:
+            while not self._closed:
+                while len(buf) < _LEN.size:
+                    chunk = sock.recv(1 << 20)
+                    if not chunk:
+                        raise ConnectionLost("server closed")
+                    buf += chunk
+                (n,) = _LEN.unpack(buf[: _LEN.size])
+                buf = buf[_LEN.size :]
+                while len(buf) < n:
+                    chunk = sock.recv(1 << 20)
+                    if not chunk:
+                        raise ConnectionLost("server closed")
+                    buf += chunk
+                msg = pickle.loads(buf[:n])
+                buf = buf[n:]
+                self._handle(msg)
+        except (ConnectionLost, ConnectionError, OSError):
+            pass
+        finally:
+            self._closed = True
+            # fail all pending calls
+            for mid, fut in list(self._pending.items()):
+                self._pending.pop(mid, None)
+                if not fut.done():
+                    fut.set_exception(ConnectionLost("connection lost"))
+            if self.on_close:
+                try:
+                    self.on_close()
+                except Exception:
+                    pass
+
+    def _handle(self, msg: dict):
+        if "push" in msg:
+            cb = self._subs.get(msg["push"])
+            if cb:
+                try:
+                    cb(msg["data"])
+                except Exception:
+                    traceback.print_exc()
+            return
+        mid = msg.get("id")
+        fut = self._pending.pop(mid, None)
+        if fut is not None and not fut.done():
+            if "error" in msg:
+                etype, estr, tb = msg["error"]
+                if etype == "ConnectionLost":
+                    fut.set_exception(ConnectionLost(estr))
+                else:
+                    fut.set_exception(
+                        RpcError(f"{etype}: {estr}\n--- remote traceback ---\n{tb}")
+                    )
+            else:
+                fut.set_result(msg["result"])
+
+    def subscribe(self, channel: str, callback: Callable):
+        self._subs[channel] = callback
+
+    def call_async(self, method: str, params: Any = None):
+        """Send a request and return a concurrent.futures.Future for its
+        result. Send order on one client is frame order at the server — the
+        ordered-submission primitive actor call pipelines rely on
+        (reference: actor_submit_queue.h sequence numbers)."""
+        from concurrent.futures import Future
+
+        if self._closed:
+            raise ConnectionLost("client closed")
+        with self._id_lock:
+            self._next_id += 1
+            mid = self._next_id
+        fut: Future = Future()
+        self._pending[mid] = fut
+        data = frame_bytes({"id": mid, "method": method, "params": params})
+        try:
+            with self._send_lock:
+                self._sock.sendall(data)
+        except OSError as e:
+            self._pending.pop(mid, None)
+            raise ConnectionLost(str(e))
+        return fut
+
+    def call(self, method: str, params: Any = None, timeout: Optional[float] = None):
+        fut = self.call_async(method, params)
+        from concurrent.futures import TimeoutError as FutTimeout
+
+        try:
+            return fut.result(timeout=timeout or self.timeout)
+        except FutTimeout:
+            # drop the orphaned future so _pending doesn't leak (a late
+            # response finds no entry and is ignored)
+            for mid, f in list(self._pending.items()):
+                if f is fut:
+                    self._pending.pop(mid, None)
+                    break
+            raise RpcError(f"rpc {method} timed out")
+
+    def notify(self, method: str, params: Any = None):
+        """Fire-and-forget (no response expected)."""
+        if self._closed:
+            raise ConnectionLost("client closed")
+        data = frame_bytes({"method": method, "params": params})
+        with self._send_lock:
+            self._sock.sendall(data)
+
+    def close(self):
+        self._closed = True
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass

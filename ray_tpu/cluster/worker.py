@@ -1,0 +1,109 @@
+"""Worker process: executes tasks and hosts actors for one node daemon.
+
+Reference: the worker side of src/ray/core_worker/core_worker.cc
+(ExecuteTask / the task execution callback into Python, _raylet.pyx
+execute_task) plus python/ray/_private/worker.py's main loop. One process
+runs one task at a time; a worker that creates an actor stays bound to it
+for the actor's lifetime (reference: dedicated actor workers).
+
+Object resolution goes through the daemon (rpc get_object), which pulls
+from peers via the GCS directory when the object is remote.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import time
+import traceback
+
+from ray_tpu.core import serialization
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.cluster.rpc import RpcClient
+
+_actor_instances = {}
+
+
+def _resolve(client: RpcClient, obj):
+    if isinstance(obj, ObjectRef):
+        payload = client.call(
+            "get_object", {"object_id": obj.id, "timeout": 60.0}, timeout=90.0
+        )
+        if payload is None:
+            raise RuntimeError(f"object {obj.id[:8]} unavailable")
+        rec = serialization.unpack(payload)
+        if rec["e"]:
+            raise rec["v"] if isinstance(rec["v"], BaseException) else RuntimeError(str(rec["v"]))
+        return rec["v"]
+    return obj
+
+
+def _pack_value(value, is_exception=False) -> bytes:
+    return serialization.pack({"e": is_exception, "v": value})
+
+
+def _execute(client: RpcClient, t: dict):
+    task_id = t["task_id"]
+    start = time.time()
+    num_returns = t.get("num_returns", 1)
+    out_ids = [
+        ObjectRef.for_task_output(task_id, i).id for i in range(num_returns)
+    ]
+    # actor method calls derive output ids the same way on the driver side
+    try:
+        spec = serialization.loads(t["spec_bytes"])
+        args = tuple(_resolve(client, a) for a in spec["args"])
+        kwargs = {k: _resolve(client, v) for k, v in spec["kwargs"].items()}
+        if t.get("actor_creation"):
+            cls = spec["func"]
+            _actor_instances[t["actor_id"]] = cls(*args, **kwargs)
+            values = [t["actor_id"]]
+        elif t.get("actor_id"):
+            inst = _actor_instances.get(t["actor_id"])
+            if inst is None:
+                raise RuntimeError(f"actor {t['actor_id']} not hosted here")
+            method = getattr(inst, spec["method_name"])
+            value = method(*args, **kwargs)
+            values = [value] if num_returns == 1 else list(value)
+        else:
+            value = spec["func"](*args, **kwargs)
+            values = [value] if num_returns == 1 else list(value)
+        if len(values) != num_returns:
+            raise ValueError(
+                f"task returned {len(values)} values, expected {num_returns}"
+            )
+        payloads = {oid: _pack_value(v) for oid, v in zip(out_ids, values)}
+        status, error = "FINISHED", None
+    except BaseException as e:  # noqa: BLE001 - worker must survive user errors
+        tb = traceback.format_exc()
+        from ray_tpu.core.exceptions import TaskError
+
+        err = TaskError(f"task {t.get('name') or task_id} failed: {e!r}", tb)
+        payloads = {oid: _pack_value(err, is_exception=True) for oid in out_ids}
+        status, error = "FAILED", f"{e!r}"
+    client.call("task_finished", {
+        "task_id": task_id,
+        "status": status,
+        "error": error,
+        "result_payloads": payloads,
+        "start": start,
+        "end": time.time(),
+    }, timeout=120.0)
+
+
+def main():  # pragma: no cover - runs as a subprocess
+    host = os.environ["RAY_TPU_DAEMON_HOST"]
+    port = int(os.environ["RAY_TPU_DAEMON_PORT"])
+    worker_id = os.environ["RAY_TPU_WORKER_ID"]
+    client = RpcClient(host, port, timeout=120.0)
+    tasks: "queue.Queue[dict]" = queue.Queue()
+    client.subscribe("run_task", tasks.put)
+    client.on_close = lambda: os._exit(0)  # daemon gone -> exit
+    client.call("worker_ready", {"worker_id": worker_id, "pid": os.getpid()})
+    while True:
+        t = tasks.get()
+        _execute(client, t)
+
+
+if __name__ == "__main__":
+    main()

@@ -48,6 +48,12 @@ def init(
         if num_tpus is not None:
             res["TPU"] = float(num_tpus)
         if address is None:
+            # worker processes inherit the cluster address (reference:
+            # RAY_ADDRESS / ray.init auto-connect inside workers)
+            import os as _os
+
+            address = _os.environ.get("RAY_TPU_GCS_ADDR") or None
+        if address is None:
             from ray_tpu.core.runtime import LocalRuntime
 
             _runtime = LocalRuntime(num_cpus=num_cpus, resources=res, config=config)

@@ -159,6 +159,14 @@ class NodeResourceState:
         self.available[idx] = 0.0
         self.total[idx] = 0.0
 
+    def revive_node(self, node_id: str, resources: Mapping[str, float]) -> None:
+        """Bring a dead row back (a daemon re-registered with the same id)."""
+        idx = self._index[node_id]
+        vec = self.space.vector(resources)
+        self.total[idx] = vec
+        self.available[idx] = vec.copy()
+        self.alive[idx] = True
+
     def update_available(self, node_id: str, available: Mapping[str, float]) -> None:
         """Overwrite a node's availability from a sync report (ray_syncer-style)."""
         idx = self._index[node_id]
