@@ -1,0 +1,4 @@
+from ray_tpu.autoscaler.autoscaler import Autoscaler, NodeTypeConfig
+from ray_tpu.autoscaler.provider import FakeNodeProvider, NodeProvider
+
+__all__ = ["Autoscaler", "NodeTypeConfig", "NodeProvider", "FakeNodeProvider"]

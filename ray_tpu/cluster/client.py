@@ -405,6 +405,17 @@ class ClusterClient:
 
     # ---------------------------------------------------------------- misc
 
+    def create_placement_group(self, pg_id, bundles, strategy, name=""):
+        return self.gcs.call("create_placement_group", {
+            "pg_id": pg_id, "bundles": bundles, "strategy": strategy, "name": name,
+        })
+
+    def remove_placement_group(self, pg_id):
+        self.gcs.call("remove_placement_group", {"pg_id": pg_id})
+
+    def get_placement_group(self, pg_id):
+        return self.gcs.call("get_placement_group", {"pg_id": pg_id})
+
     def kill_actor(self, actor_id: str, no_restart: bool = True):
         self.gcs.call("kill_actor", {"actor_id": actor_id})
         with self._lock:

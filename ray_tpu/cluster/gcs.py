@@ -321,6 +321,42 @@ class GcsServer:
                 "placement_groups": len(self.placement_groups),
             }
 
+    def rpc_autoscaler_state(self, p, conn):
+        """Demand snapshot for the autoscaler (reference: the GCS-side demand
+        the monitor polls — gcs_autoscaler_state_manager.cc in v2)."""
+        with self._lock:
+            demand: Dict[Tuple, int] = defaultdict(int)
+            for t in self.pending:
+                key = tuple(sorted(t["resources"].items()))
+                demand[key] += 1
+            for pg in self.placement_groups.values():
+                if pg["state"] == "PENDING":
+                    for b in pg["bundles"]:
+                        demand[tuple(sorted(b.items()))] += 1
+            running_per_node: Dict[str, int] = defaultdict(int)
+            for info in self.running.values():
+                running_per_node[info["node_id"]] += 1
+            nodes = {}
+            for nid, n in self.nodes.items():
+                idx = self.state.node_index(nid)
+                avail = (
+                    self.space.unvector(self.state.available[idx])
+                    if idx is not None else {}
+                )
+                nodes[nid] = {
+                    "resources": n["resources"],
+                    "available": avail,
+                    "alive": n["alive"],
+                    "labels": n.get("labels", {}),
+                    "running": running_per_node.get(nid, 0),
+                }
+            return {
+                "pending_demand": [
+                    {"resources": dict(k), "count": v} for k, v in demand.items()
+                ],
+                "nodes": nodes,
+            }
+
     # ------------------------------------------------------- placement groups
 
     def rpc_create_placement_group(self, p, conn):
@@ -394,6 +430,7 @@ class GcsServer:
         kernel call -> dispatch pushes to daemons."""
         with self._lock:
             if not self.pending:
+                self._retry_pending_pgs()
                 return
             batch = list(self.pending)
             self.pending.clear()

@@ -83,6 +83,7 @@ class LocalRuntime:
         self._infeasible: deque = deque()
         self._running: Dict[str, TaskSpec] = {}
         self._actors: Dict[str, _ActorState] = {}
+        self._pgs: Dict[str, dict] = {}
         self._task_events: List[dict] = []  # timeline (ray timeline equivalent)
 
         self._sched_cv = threading.Condition()
@@ -183,12 +184,37 @@ class LocalRuntime:
     def _schedule_round(self):
         """One batched round: group pending by scheduling class, run the
         policy kernel, dispatch. Reference: ScheduleAndDispatchTasks."""
+        self._retry_pending_pgs_local()
         with self._lock:
             if not self._pending and not self._infeasible:
                 return
             batch = list(self._pending) + list(self._infeasible)
             self._pending.clear()
             self._infeasible.clear()
+
+        rest = []
+        for spec in batch:
+            if spec.strategy.kind == "PLACEMENT_GROUP":
+                # tasks ride inside their bundle's reservation (zero extra
+                # demand once the PG is placed)
+                pg = self._pgs.get(spec.strategy.placement_group_id)
+                if pg is None:
+                    # nonexistent/removed PG can never become schedulable
+                    self._store_error(spec, TaskError(
+                        f"placement group {spec.strategy.placement_group_id} "
+                        f"does not exist"))
+                    with self._lock:
+                        self._running.pop(spec.task_id, None)
+                elif pg["state"] == "CREATED":
+                    self._dispatch(spec, 0, self.space.vector({}))
+                else:
+                    with self._lock:
+                        self._infeasible.append(spec)
+            else:
+                rest.append(spec)
+        batch = rest
+        if not batch:
+            return
 
         classes: Dict[Tuple, List[TaskSpec]] = defaultdict(list)
         for spec in batch:
@@ -211,6 +237,23 @@ class LocalRuntime:
             for spec in specs[placed:]:
                 with self._lock:
                     self._infeasible.append(spec)
+
+    def _retry_pending_pgs_local(self):
+        from ray_tpu.sched.bundles import schedule_bundles
+
+        for pg in list(self._pgs.values()):
+            if pg["state"] != "PENDING":
+                continue
+            with self._lock:
+                mat = np.stack([self.space.vector(b) for b in pg["bundles"]])
+                nodes, new_avail = schedule_bundles(
+                    self.state.available, self.state.total, self.state.alive,
+                    mat, strategy=pg["strategy"],
+                )
+                if nodes is not None:
+                    self.state.available = new_avail
+                    pg["state"] = "CREATED"
+                    pg["nodes"] = [self.state.node_ids[i] for i in nodes]
 
     def _dispatch(self, spec: TaskSpec, node_idx: int, demand: np.ndarray):
         with self._lock:
@@ -495,6 +538,40 @@ class LocalRuntime:
 
     def free(self, refs: List[ObjectRef]):
         self.store.delete(refs)
+
+    # --------------------------------------------------------- placement groups
+
+    def create_placement_group(self, pg_id, bundles, strategy, name=""):
+        """Single-node PG support (reference semantics; the multi-node path
+        lives in cluster/gcs.py)."""
+        from ray_tpu.sched.bundles import schedule_bundles
+
+        with self._lock:
+            mat = np.stack([self.space.vector(b) for b in bundles])
+            nodes, new_avail = schedule_bundles(
+                self.state.available, self.state.total, self.state.alive,
+                mat, strategy=strategy,
+            )
+            if nodes is None:
+                self._pgs[pg_id] = {"pg_id": pg_id, "state": "PENDING",
+                                    "bundles": bundles, "strategy": strategy}
+                return {"ok": False, "state": "PENDING"}
+            self.state.available = new_avail
+            self._pgs[pg_id] = {"pg_id": pg_id, "state": "CREATED",
+                                "bundles": bundles, "strategy": strategy,
+                                "nodes": [self.state.node_ids[i] for i in nodes]}
+            return {"ok": True, "state": "CREATED"}
+
+    def remove_placement_group(self, pg_id):
+        with self._lock:
+            pg = self._pgs.pop(pg_id, None)
+            if pg and pg.get("state") == "CREATED":
+                for b in pg["bundles"]:
+                    self.state.release(0, self.space.vector(b))
+        self._kick()
+
+    def get_placement_group(self, pg_id):
+        return self._pgs.get(pg_id)
 
     # ------------------------------------------------------------------- misc
 

@@ -1,0 +1,116 @@
+"""Autoscaler tests (reference analogs: python/ray/tests/test_autoscaler.py,
+test_resource_demand_scheduler.py — pure-function launch decisions — and
+test_autoscaler_fake_multinode.py — end-to-end with the fake provider)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import Autoscaler, FakeNodeProvider, NodeTypeConfig
+from ray_tpu.autoscaler.autoscaler import get_nodes_to_launch
+from ray_tpu.cluster import Cluster
+from ray_tpu.sched.resources import ResourceSpace
+
+
+# ---- pure launch-decision tests (reference: MockProvider-style unit tests)
+
+
+def _empty(space):
+    R = space.max_resources
+    return (
+        np.zeros((0, R), np.float32),
+        np.zeros((0, R), np.float32),
+        np.zeros((0,), bool),
+    )
+
+
+def test_launch_for_simple_demand():
+    space = ResourceSpace()
+    avail, total, alive = _empty(space)
+    launch = get_nodes_to_launch(
+        space, avail, total, alive,
+        [{"resources": {"CPU": 1}, "count": 10}],
+        [NodeTypeConfig("cpu4", {"CPU": 4}, max_workers=10)],
+        {},
+    )
+    assert launch == {"cpu4": 3}  # ceil(10/4) with hybrid packing
+
+
+def test_launch_respects_max_workers():
+    space = ResourceSpace()
+    avail, total, alive = _empty(space)
+    launch = get_nodes_to_launch(
+        space, avail, total, alive,
+        [{"resources": {"CPU": 1}, "count": 100}],
+        [NodeTypeConfig("cpu4", {"CPU": 4}, max_workers=2)],
+        {},
+    )
+    assert launch == {"cpu4": 2}
+
+
+def test_launch_picks_matching_type():
+    space = ResourceSpace()
+    avail, total, alive = _empty(space)
+    launch = get_nodes_to_launch(
+        space, avail, total, alive,
+        [{"resources": {"TPU": 1, "CPU": 1}, "count": 2}],
+        [
+            NodeTypeConfig("cpu-only", {"CPU": 16}, max_workers=5),
+            NodeTypeConfig("tpu-host", {"CPU": 8, "TPU": 4}, max_workers=5),
+        ],
+        {},
+    )
+    assert "tpu-host" in launch
+    assert "cpu-only" not in launch
+
+
+def test_no_launch_when_existing_capacity_fits():
+    space = ResourceSpace()
+    total = np.stack([space.vector({"CPU": 8})])
+    avail = total.copy()
+    alive = np.ones(1, bool)
+    launch = get_nodes_to_launch(
+        space, avail, total, alive,
+        [{"resources": {"CPU": 1}, "count": 4}],
+        [NodeTypeConfig("cpu4", {"CPU": 4}, max_workers=10)],
+        {},
+    )
+    assert launch == {}
+
+
+# ---- end-to-end with the fake provider
+
+
+@pytest.mark.slow
+def test_autoscaler_scales_up_and_down():
+    c = Cluster()
+    provider = FakeNodeProvider((c.host, c.gcs.port), config=c.config)
+    scaler = Autoscaler(
+        (c.host, c.gcs.port), provider,
+        [NodeTypeConfig("cpu2", {"CPU": 2, "memory": 2**30}, min_workers=0,
+                        max_workers=4)],
+        idle_timeout_s=2.0, update_interval_s=0.3,
+    ).start()
+    ray_tpu.init(address=c.address)
+    try:
+        @ray_tpu.remote(num_cpus=1)
+        def work(t):
+            time.sleep(t)
+            return 1
+
+        # no nodes at all: demand must trigger scale-up
+        refs = [work.remote(1.0) for _ in range(6)]
+        assert sum(ray_tpu.get(refs, timeout=120)) == 6
+        assert len(provider.non_terminated_nodes()) >= 1
+        # idle nodes must be reclaimed
+        deadline = time.time() + 30
+        while time.time() < deadline and provider.non_terminated_nodes():
+            time.sleep(0.5)
+        assert provider.non_terminated_nodes() == []
+    finally:
+        ray_tpu.shutdown()
+        scaler.shutdown()
+        provider.shutdown()
+        c.shutdown()

@@ -1,0 +1,163 @@
+"""Placement group tests (reference analogs:
+python/ray/tests/test_placement_group_*.py)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster import Cluster
+from ray_tpu.sched import bundles as bundles_mod
+from ray_tpu.sched.resources import NodeResourceState, ResourceSpace
+from ray_tpu.util.placement_group import (
+    placement_group,
+    remove_placement_group,
+)
+from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
+
+
+# ---- pure packing-kernel tests (reference: bundle_scheduling_policy tests)
+
+
+def make_state(node_resources):
+    space = ResourceSpace()
+    st = NodeResourceState(space=space)
+    for i, res in enumerate(node_resources):
+        st.add_node(f"n{i}", res)
+    return st
+
+
+def pack(st, bundle_maps, strategy):
+    mat = np.stack([st.space.vector(b) for b in bundle_maps])
+    return bundles_mod.schedule_bundles(
+        st.available, st.total, st.alive, mat, strategy=strategy
+    )
+
+
+def test_strict_pack_one_node():
+    st = make_state([{"CPU": 2}, {"CPU": 8}])
+    nodes, _ = pack(st, [{"CPU": 2}, {"CPU": 2}, {"CPU": 2}], "STRICT_PACK")
+    assert nodes is not None and len(set(nodes)) == 1
+    assert nodes[0] == 1  # only node 1 fits all 6 CPUs
+
+
+def test_strict_pack_infeasible():
+    st = make_state([{"CPU": 2}, {"CPU": 2}])
+    nodes, _ = pack(st, [{"CPU": 2}, {"CPU": 2}], "STRICT_PACK")
+    assert nodes is None
+
+
+def test_strict_spread_distinct_nodes():
+    st = make_state([{"CPU": 4}] * 3)
+    nodes, _ = pack(st, [{"CPU": 1}, {"CPU": 1}, {"CPU": 1}], "STRICT_SPREAD")
+    assert nodes is not None and len(set(nodes)) == 3
+
+
+def test_strict_spread_infeasible_few_nodes():
+    st = make_state([{"CPU": 4}] * 2)
+    nodes, _ = pack(st, [{"CPU": 1}] * 3, "STRICT_SPREAD")
+    assert nodes is None
+
+
+def test_pack_best_fit():
+    st = make_state([{"CPU": 16}, {"CPU": 2}])
+    nodes, _ = pack(st, [{"CPU": 2}], "PACK")
+    assert nodes is not None and nodes[0] == 1  # best fit -> small node
+
+
+def test_spread_prefers_distinct():
+    st = make_state([{"CPU": 8}] * 2)
+    nodes, _ = pack(st, [{"CPU": 1}, {"CPU": 1}], "SPREAD")
+    assert nodes is not None and len(set(nodes)) == 2
+
+
+def test_strict_pack_batch_kernel():
+    st = make_state([{"CPU": 8}] * 4)
+    pg_demands = np.stack([st.space.vector({"CPU": 4}) for _ in range(6)])
+    nodes, _ = bundles_mod.strict_pack_batch(
+        st.available, st.total, st.alive, pg_demands
+    )
+    assert (nodes >= 0).sum() == 6  # 2 PGs per node x 4 nodes >= 6
+    counts = np.bincount(nodes[nodes >= 0], minlength=4)
+    assert counts.max() <= 2
+
+
+# ---- end-to-end (local mode)
+
+
+def test_pg_local_mode(local_ray):
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+    assert pg.ready(timeout=10)
+    avail = ray_tpu.available_resources()
+    assert avail["CPU"] == 2.0  # 2 of 4 reserved
+    remove_placement_group(pg)
+    import time
+
+    time.sleep(0.1)
+    assert ray_tpu.available_resources()["CPU"] == 4.0
+
+
+def test_pg_local_task_rides_bundle(local_ray):
+    pg = placement_group([{"CPU": 2}], strategy="STRICT_PACK")
+    assert pg.ready(timeout=10)
+
+    @ray_tpu.remote(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(placement_group=pg)
+    )
+    def inside():
+        return "in-pg"
+
+    assert ray_tpu.get(inside.remote(), timeout=10) == "in-pg"
+
+
+def test_pg_validation(local_ray):
+    with pytest.raises(ValueError, match="strategy"):
+        placement_group([{"CPU": 1}], strategy="NOPE")
+    with pytest.raises(ValueError, match="bundles"):
+        placement_group([])
+
+
+# ---- end-to-end (cluster mode)
+
+
+@pytest.fixture
+def pg_cluster():
+    c = Cluster()
+    c.add_node(num_cpus=4, node_id="pg-a")
+    c.add_node(num_cpus=4, node_id="pg-b")
+    c.wait_for_nodes(2)
+    ray_tpu.init(address=c.address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def test_pg_cluster_strict_spread_and_tasks(pg_cluster):
+    pg = placement_group([{"CPU": 2}, {"CPU": 2}], strategy="STRICT_SPREAD")
+    assert pg.ready(timeout=15)
+    st = ray_tpu.core.api._get_runtime().get_placement_group(pg.id)
+    assert st["state"] == "CREATED"
+    assert len(set(st["nodes"])) == 2
+
+    @ray_tpu.remote(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_bundle_index=0
+        )
+    )
+    def where():
+        import os
+
+        return os.environ["RAY_TPU_NODE_ID"]
+
+    assert ray_tpu.get(where.remote(), timeout=60) == st["nodes"][0]
+    remove_placement_group(pg)
+
+
+def test_pg_cluster_pending_then_created(pg_cluster):
+    big = placement_group([{"CPU": 4}, {"CPU": 4}], strategy="STRICT_SPREAD")
+    assert big.ready(timeout=15)
+    # second identical PG can't fit until the first is removed
+    second = placement_group([{"CPU": 4}, {"CPU": 4}], strategy="STRICT_SPREAD")
+    assert not second.ready(timeout=1.0)
+    remove_placement_group(big)
+    assert second.ready(timeout=15)
+    remove_placement_group(second)
