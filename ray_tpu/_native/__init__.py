@@ -1,0 +1,38 @@
+"""Native (C++) components of ray_tpu.
+
+The reference implements its data plane and runtime in C++
+(src/ray/object_manager/plasma/, src/ray/raylet/); ray_tpu keeps the same
+split: compute on TPU via JAX/XLA, the host data plane in C++.  Sources are
+compiled on first use with the system toolchain (no pip deps) and cached
+next to the source, keyed by source mtime.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_LOCK = threading.Lock()
+
+
+def _build(src: str, out: str) -> None:
+    cmd = [
+        "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+        "-o", out + ".tmp", src, "-lpthread", "-lrt",
+    ]
+    subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(out + ".tmp", out)
+
+
+def load_library(name: str):
+    """Compile (if stale) and dlopen `<name>.cc` from this directory."""
+    import ctypes
+
+    src = os.path.join(_HERE, name + ".cc")
+    out = os.path.join(_HERE, "lib" + name + ".so")
+    with _LOCK:
+        if not os.path.exists(out) or os.path.getmtime(out) < os.path.getmtime(src):
+            _build(src, out)
+    return ctypes.CDLL(out)

@@ -566,8 +566,9 @@ class LocalRuntime:
         with self._lock:
             pg = self._pgs.pop(pg_id, None)
             if pg and pg.get("state") == "CREATED":
-                for b in pg["bundles"]:
-                    self.state.release(0, self.space.vector(b))
+                index_of = {nid: i for i, nid in enumerate(self.state.node_ids)}
+                for b, nid in zip(pg["bundles"], pg["nodes"]):
+                    self.state.release(index_of[nid], self.space.vector(b))
         self._kick()
 
     def get_placement_group(self, pg_id):

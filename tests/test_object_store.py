@@ -1,0 +1,158 @@
+"""Tests for the C++ shm object store.
+
+Mirrors the reference's plasma test coverage style
+(src/ray/object_manager/plasma/ + python/ray/tests/test_object_store.py):
+lifecycle, zero-copy, eviction under pressure, cross-process visibility.
+"""
+
+import multiprocessing
+import os
+
+import numpy as np
+import pytest
+
+from ray_tpu.object_store import (
+    ObjectStore,
+    StoreFullError,
+    ObjectExistsError,
+)
+
+
+def oid(i: int) -> bytes:
+    return i.to_bytes(4, "big") + os.urandom(16) if False else i.to_bytes(20, "big")
+
+
+@pytest.fixture
+def store():
+    name = f"/rts_test_{os.getpid()}_{np.random.randint(1 << 30)}"
+    s = ObjectStore.create(name, capacity=1 << 20, max_objects=256)
+    yield s
+    s.close()
+
+
+def test_put_get_roundtrip(store):
+    store.put(oid(1), b"hello world")
+    view = store.get(oid(1))
+    assert bytes(view) == b"hello world"
+    store.release(oid(1))
+
+
+def test_get_missing_returns_none(store):
+    assert store.get(oid(99)) is None
+
+
+def test_unsealed_not_readable(store):
+    buf = store.create_buffer(oid(2), 4)
+    buf[:] = b"abcd"
+    assert store.get(oid(2)) is None  # not sealed yet
+    assert store.contains(oid(2)) is False
+    store.seal(oid(2))
+    assert store.contains(oid(2)) is True
+    assert bytes(store.get(oid(2))) == "abcd".encode()
+
+
+def test_duplicate_create_raises(store):
+    store.put(oid(3), b"x")
+    with pytest.raises(ObjectExistsError):
+        store.create_buffer(oid(3), 1)
+
+
+def test_zero_copy_numpy(store):
+    arr = np.arange(1000, dtype=np.float32)
+    store.put(oid(4), arr.tobytes())
+    view = store.get(oid(4))
+    out = np.frombuffer(view, dtype=np.float32)
+    np.testing.assert_array_equal(out, arr)
+    # the view is read-only (sealed objects are immutable)
+    with pytest.raises(ValueError):
+        out[0] = 1.0
+    store.release(oid(4))
+
+
+def test_delete_frees_space(store):
+    before = store.stats()["used"]
+    store.put(oid(5), b"z" * 4096)
+    assert store.stats()["used"] > before
+    store.delete(oid(5))
+    assert store.stats()["used"] == before
+    assert store.get(oid(5)) is None
+
+
+def test_delete_deferred_while_pinned(store):
+    store.put(oid(6), b"pinned")
+    view = store.get(oid(6))  # pin
+    store.delete(oid(6))
+    # still readable through the existing view; freed on release
+    assert bytes(view) == b"pinned"
+    store.release(oid(6))
+    assert store.get(oid(6)) is None
+
+
+def test_lru_eviction_under_pressure(store):
+    # fill most of the 1MB store with 64KB objects, then keep inserting:
+    # oldest unpinned sealed objects must be evicted, newest survive.
+    blob = b"e" * (64 << 10)
+    for i in range(100, 130):
+        store.put(oid(i), blob)
+    stats = store.stats()
+    assert stats["n_evictions"] > 0
+    assert store.get(oid(129)) is not None  # newest survives
+    store.release(oid(129))
+    assert store.get(oid(100)) is None  # oldest evicted
+
+
+def test_pinned_objects_survive_eviction(store):
+    blob = b"p" * (64 << 10)
+    store.put(oid(200), blob)
+    pinned = store.get(oid(200))  # pin
+    for i in range(201, 240):
+        store.put(oid(i), blob)
+    assert bytes(pinned[:4]) == b"pppp"  # still alive despite pressure
+    store.release(oid(200))
+
+
+def test_store_full_when_nothing_evictable(store):
+    with pytest.raises(StoreFullError):
+        store.put(oid(300), b"x" * (2 << 20))  # bigger than capacity
+
+
+def test_free_list_coalescing(store):
+    # alloc a,b,c; free b then a; a+b coalesce so a big object fits again
+    store.put(oid(400), b"a" * (256 << 10))
+    store.put(oid(401), b"b" * (256 << 10))
+    store.put(oid(402), b"c" * (256 << 10))
+    store.delete(oid(400))
+    store.delete(oid(401))
+    store.put(oid(403), b"d" * (500 << 10))  # needs the coalesced hole
+    assert store.contains(oid(403))
+
+
+def _child_attach(name, result_q):
+    s = ObjectStore.attach(name)
+    view = s.get(b"A" * 20)
+    result_q.put(bytes(view) if view is not None else None)
+    s.put(b"B" * 20, b"from-child")
+    s.close()
+
+
+def test_cross_process_visibility():
+    name = f"/rts_xproc_{os.getpid()}"
+    s = ObjectStore.create(name, capacity=1 << 20)
+    try:
+        s.put(b"A" * 20, b"from-parent")
+        ctx = multiprocessing.get_context("spawn")
+        q = ctx.Queue()
+        p = ctx.Process(target=_child_attach, args=(name, q))
+        p.start()
+        got = q.get(timeout=30)
+        p.join(timeout=30)
+        assert got == b"from-parent"
+        assert bytes(s.get(b"B" * 20)) == b"from-child"
+    finally:
+        s.close()
+
+
+def test_stats_shape(store):
+    st = store.stats()
+    assert set(st) == {"used", "capacity", "n_objects", "n_evictions", "bytes_evicted"}
+    assert st["capacity"] >= 1 << 20
