@@ -18,12 +18,16 @@ _LOCK = threading.Lock()
 
 
 def _build(src: str, out: str) -> None:
+    # per-process tmp name: concurrent first-use builds from the daemon and
+    # its subprocess workers must not interleave writes before the atomic
+    # publish below
+    tmp = f"{out}.{os.getpid()}.tmp"
     cmd = [
         "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-        "-o", out + ".tmp", src, "-lpthread", "-lrt",
+        "-o", tmp, src, "-lpthread", "-lrt",
     ]
     subprocess.run(cmd, check=True, capture_output=True)
-    os.replace(out + ".tmp", out)
+    os.replace(tmp, out)
 
 
 def load_library(name: str):

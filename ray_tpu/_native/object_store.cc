@@ -25,8 +25,9 @@
 #include <unistd.h>
 
 #include <errno.h>
+#include <deque>
+#include <mutex>
 #include <new>
-#include <vector>
 
 namespace {
 
@@ -86,9 +87,17 @@ struct Mapping {
   bool valid = false;
 };
 
-std::vector<Mapping>& mappings() {
-  static std::vector<Mapping> m;
+// deque: elements never move on push_back, so Mapping* stays valid while
+// another thread attaches; the mutex guards push_back vs. size reads
+// (ctypes releases the GIL during calls, so rts_* can run concurrently)
+std::deque<Mapping>& mappings() {
+  static std::deque<Mapping> m;
   return m;
+}
+
+std::mutex& mappings_mutex() {
+  static std::mutex mu;
+  return mu;
 }
 
 uint64_t align_up(uint64_t x, uint64_t a) { return (x + a - 1) & ~(a - 1); }
@@ -310,13 +319,19 @@ int64_t do_map(const char* name, bool create, uint64_t capacity, uint32_t max_ob
   }
 
   m.valid = true;
+  std::lock_guard<std::mutex> g(mappings_mutex());
   mappings().push_back(m);
   return (int64_t)mappings().size() - 1;
 }
 
 Mapping* get_mapping(int64_t h) {
   auto& ms = mappings();
-  if (h < 0 || (size_t)h >= ms.size() || !ms[h].valid) return nullptr;
+  size_t n;
+  {
+    std::lock_guard<std::mutex> g(mappings_mutex());
+    n = ms.size();
+  }
+  if (h < 0 || (size_t)h >= n || !ms[h].valid) return nullptr;
   return &ms[h];
 }
 
@@ -359,10 +374,12 @@ int64_t rts_obj_create(int64_t h, const uint8_t* id, uint64_t size) {
   int64_t slot = insert_slot(*m, id);
   if (slot < 0) return -2;  // table full
   uint64_t off = arena_alloc(*m, size);
-  if (off == kNil) {
-    evict_lru(*m, align_up(size + sizeof(AllocHeader), kAlign));
+  // evict_lru counts freed bytes that may be non-contiguous; keep evicting
+  // until the allocation fits or nothing evictable remains
+  while (off == kNil) {
+    if (evict_lru(*m, align_up(size + sizeof(AllocHeader), kAlign)) == 0)
+      return -2;
     off = arena_alloc(*m, size);
-    if (off == kNil) return -2;
   }
   Entry& e = m->entries[slot];
   memcpy(e.id, id, kIdLen);

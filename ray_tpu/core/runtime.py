@@ -566,9 +566,8 @@ class LocalRuntime:
         with self._lock:
             pg = self._pgs.pop(pg_id, None)
             if pg and pg.get("state") == "CREATED":
-                index_of = {nid: i for i, nid in enumerate(self.state.node_ids)}
                 for b, nid in zip(pg["bundles"], pg["nodes"]):
-                    self.state.release(index_of[nid], self.space.vector(b))
+                    self.state.release(self.state.node_index(nid), self.space.vector(b))
         self._kick()
 
     def get_placement_group(self, pg_id):

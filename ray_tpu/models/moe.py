@@ -1,0 +1,138 @@
+"""Mixture-of-experts FFN with expert parallelism ("ep" mesh axis).
+
+The reference has no MoE/expert-parallel code in-tree (SURVEY §2.5: absent).
+TPU-native design: GShard/Switch-style dense dispatch — routing is expressed
+as einsums over a [tokens, experts, capacity] one-hot dispatch tensor, and
+expert weights are sharded over the "ep" axis, so XLA SPMD inserts the
+all_to_all on ICI from the shardings alone. No per-expert Python loop, no
+dynamic shapes: over-capacity tokens are dropped (contribute zero), the
+standard static-shape MoE trade.
+
+Layout: x [G, S, D] with G (token groups = batch) sharded over "dp";
+expert weights [E, D, F] sharded over "ep".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    d_model: int = 256
+    d_ff: int = 512
+    n_experts: int = 8
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.bfloat16
+
+
+def init_moe_params(key, cfg: MoEConfig) -> Dict:
+    kr, k1, k2 = jax.random.split(key, 3)
+    E, D, F = cfg.n_experts, cfg.d_model, cfg.d_ff
+    s = lambda k, shape, fan: jax.random.normal(k, shape, jnp.float32) / jnp.sqrt(fan)
+    return {
+        "router": s(kr, (D, E), D),
+        "w1": s(k1, (E, D, F), D),
+        "w2": s(k2, (E, F, D), F),
+    }
+
+
+def moe_partition_specs() -> Dict:
+    return {
+        "router": P(None, None),
+        "w1": P("ep", None, None),
+        "w2": P("ep", None, None),
+    }
+
+
+def _capacity(cfg: MoEConfig, S: int) -> int:
+    return max(1, int(S * cfg.capacity_factor / cfg.n_experts))
+
+
+def moe_ffn(
+    params: Dict, x: jnp.ndarray, cfg: MoEConfig, mesh=None
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Top-1 (Switch) MoE FFN. x: [G, S, D] -> (y [G, S, D], aux_loss []).
+
+    aux_loss is the Switch load-balancing loss
+    (E * mean_e[frac_tokens_e * mean_prob_e]); add it to the task loss.
+    """
+    G, S, D = x.shape
+    E, C = cfg.n_experts, _capacity(cfg, S)
+    logits = jnp.einsum(
+        "gsd,de->gse", x.astype(jnp.float32), params["router"]
+    )
+    probs = jax.nn.softmax(logits, axis=-1)  # [G,S,E]
+    expert = jnp.argmax(probs, axis=-1)  # [G,S]
+    gate = jnp.max(probs, axis=-1)  # [G,S]
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)  # [G,S,E]
+
+    # position of each token within its expert's queue; drop past capacity
+    pos = jnp.cumsum(onehot, axis=1) * onehot - 1.0  # [G,S,E], -1 if not routed
+    keep = (pos >= 0) & (pos < C)
+    dispatch = keep[..., None] * jax.nn.one_hot(
+        jnp.clip(pos, 0, C - 1).astype(jnp.int32), C, dtype=jnp.float32
+    )  # [G,S,E,C]
+    combine = dispatch * gate[..., None, None]
+
+    # all_to_all happens here: [G(dp),S,E,C] x [G,S,D] -> [E(ep),G,C,D]
+    expert_in = jnp.einsum("gsec,gsd->egcd", dispatch, x.astype(jnp.float32))
+    if mesh is not None:
+        from jax.sharding import NamedSharding
+
+        expert_in = jax.lax.with_sharding_constraint(
+            expert_in, NamedSharding(mesh, P("ep", "dp", None, None))
+        )
+    h = jax.nn.gelu(
+        jnp.einsum(
+            "egcd,edf->egcf",
+            expert_in.astype(cfg.dtype),
+            params["w1"].astype(cfg.dtype),
+            preferred_element_type=jnp.float32,
+        )
+    )
+    out = jnp.einsum(
+        "egcf,efd->egcd",
+        h.astype(cfg.dtype),
+        params["w2"].astype(cfg.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    y = jnp.einsum("gsec,egcd->gsd", combine, out).astype(x.dtype)
+
+    frac_tokens = onehot.mean(axis=(0, 1))  # [E]
+    mean_prob = probs.mean(axis=(0, 1))  # [E]
+    aux = E * jnp.sum(frac_tokens * mean_prob)
+    return y, aux
+
+
+def reference_moe_ffn(params: Dict, x: jnp.ndarray, cfg: MoEConfig) -> jnp.ndarray:
+    """Per-token loop-free dense reference (no capacity drops) for tests:
+    every token goes through its argmax expert."""
+    logits = jnp.einsum("gsd,de->gse", x.astype(jnp.float32), params["router"])
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)
+    gate = jnp.max(probs, axis=-1)
+    # run every token through every expert, then select (test-only cost)
+    h = jax.nn.gelu(
+        jnp.einsum(
+            "gsd,edf->gsef",
+            x.astype(cfg.dtype),
+            params["w1"].astype(cfg.dtype),
+            preferred_element_type=jnp.float32,
+        )
+    )
+    out = jnp.einsum(
+        "gsef,efd->gsed",
+        h.astype(cfg.dtype),
+        params["w2"].astype(cfg.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    sel = jnp.take_along_axis(
+        out, expert[..., None, None], axis=2
+    )[:, :, 0, :]
+    return (sel * gate[..., None]).astype(x.dtype)

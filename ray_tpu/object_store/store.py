@@ -104,6 +104,23 @@ class ObjectStore:
         return cls(h, name, owns=False)
 
     def close(self) -> None:
+        """Drop the store (unlinks the shm name if this process created it).
+
+        The mapping itself is NOT munmapped: zero-copy views returned by
+        get()/create_buffer() point straight into it, and unmapping under
+        them would turn later reads into segfaults (plasma keeps buffers
+        alive through client refs; here the mapping is process-lifetime
+        instead — one bounded mapping per store, reclaimed at exit). Call
+        detach() only when no views are outstanding.
+        """
+        if self._h >= 0:
+            if self._owns:
+                self._lib.rts_unlink(self._name.encode())
+            self._h = -1
+
+    def detach(self) -> None:
+        """munmap the segment. UNSAFE while any view from get()/
+        create_buffer() is still referenced."""
         if self._h >= 0:
             self._lib.rts_detach(self._h)
             if self._owns:

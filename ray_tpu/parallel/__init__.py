@@ -1,3 +1,10 @@
 from ray_tpu.parallel.mesh import make_mesh, mesh_shape_for
+from ray_tpu.parallel.pipeline import pipeline_apply
+from ray_tpu.parallel.ring_attention import ring_attention
 
-__all__ = ["make_mesh", "mesh_shape_for"]
+__all__ = [
+    "make_mesh",
+    "mesh_shape_for",
+    "pipeline_apply",
+    "ring_attention",
+]

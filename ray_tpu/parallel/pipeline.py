@@ -1,0 +1,116 @@
+"""In-mesh pipeline parallelism: GPipe schedule compiled as one SPMD program.
+
+The reference has no pipeline engine in-tree — PP exists only as an
+orchestration pattern (actors as stages; SURVEY §2.5). The TPU-native design
+runs ALL stages inside one jitted program over a "pp" mesh axis: stage
+parameters are sharded over the axis (leading stage dim), activations move
+stage-to-stage with `lax.ppermute` (one ICI hop), and the M-microbatch GPipe
+schedule is a `lax.scan` over M + P - 1 ticks. The bubble is the usual
+(P-1)/(M+P-1); no host round-trips, no per-stage processes.
+
+Constraint: the stage function must be shape-preserving ([B_m, ...] ->
+[B_m, ...]), which holds for transformer blocks.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _stage_specs(params: Any, axis_name: str):
+    """Every param leaf carries a leading [n_stages] dim sharded over pp."""
+    return jax.tree.map(
+        lambda leaf: P(axis_name, *([None] * (jnp.ndim(leaf) - 1))), params
+    )
+
+
+def _pipeline_local(params, x_mb, *, stage_fn, axis_name):
+    """Per-device GPipe schedule (runs under shard_map).
+
+    params: local stage params, leaves [1, ...]; x_mb: [M, B_m, ...]
+    (replicated). Returns [M, B_m, ...] outputs, replicated via psum.
+    """
+    p = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    my_params = jax.tree.map(lambda leaf: leaf[0], params)
+    M = x_mb.shape[0]
+    fwd = [(i, i + 1) for i in range(p - 1)]  # no wraparound
+
+    from ray_tpu.parallel.ring_attention import _pvary
+
+    outputs = _pvary(jnp.zeros_like(x_mb), axis_name)
+    x = _pvary(jnp.zeros_like(x_mb[0]), axis_name)
+
+    def tick(carry, t):
+        outputs, x = carry
+        # stage 0 injects microbatch t during the feed phase
+        mb = jax.lax.dynamic_index_in_dim(
+            x_mb, jnp.clip(t, 0, M - 1), axis=0, keepdims=False
+        )
+        x = jnp.where(jnp.logical_and(idx == 0, t < M), mb, x)
+        y = stage_fn(my_params, x)
+        # last stage emits microbatch t-(P-1) once the pipe is full
+        out_t = t - (p - 1)
+        upd = jax.lax.dynamic_update_index_in_dim(
+            outputs, y, jnp.clip(out_t, 0, M - 1), 0
+        )
+        emit = jnp.logical_and(idx == p - 1, out_t >= 0)
+        outputs = jnp.where(emit, upd, outputs)
+        x = jax.lax.ppermute(y, axis_name, fwd)  # stage 0 receives zeros
+        return (outputs, x), None
+
+    (outputs, _), _ = jax.lax.scan(
+        tick, (outputs, x), jnp.arange(M + p - 1)
+    )
+    # only the last device wrote; psum replicates the result everywhere
+    return jax.lax.psum(outputs, axis_name)
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    stage_params: Any,
+    x_microbatches: jnp.ndarray,
+    mesh: Mesh,
+    *,
+    axis_name: str = "pp",
+) -> jnp.ndarray:
+    """Run `x_microbatches` [M, B_m, ...] through P pipeline stages.
+
+    stage_params: pytree whose leaves have leading dim n_stages == size of
+    `axis_name`; stage i applies `stage_fn(params_i, x)`. Returns the final
+    stage's outputs [M, B_m, ...], replicated over the axis.
+    """
+    n_stages = mesh.shape[axis_name]
+    for leaf in jax.tree.leaves(stage_params):
+        if leaf.shape[0] != n_stages:
+            raise ValueError(
+                f"stage param leading dim {leaf.shape[0]} != "
+                f"mesh axis {axis_name}={n_stages}"
+            )
+    fn = functools.partial(
+        _pipeline_local, stage_fn=stage_fn, axis_name=axis_name
+    )
+    return jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(_stage_specs(stage_params, axis_name), P()),
+        out_specs=P(),
+    )(stage_params, x_microbatches)
+
+
+def reference_pipeline(stage_fn, stage_params, x_microbatches):
+    """Sequential reference for tests: apply stages one after another."""
+    n_stages = jax.tree.leaves(stage_params)[0].shape[0]
+    out = []
+    for m in range(x_microbatches.shape[0]):
+        x = x_microbatches[m]
+        for s in range(n_stages):
+            params_s = jax.tree.map(lambda leaf: leaf[s], stage_params)
+            x = stage_fn(params_s, x)
+        out.append(x)
+    return jnp.stack(out)

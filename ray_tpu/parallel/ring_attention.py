@@ -1,0 +1,144 @@
+"""Ring attention: sequence/context parallelism over an ICI mesh axis.
+
+The reference has NO sequence parallelism anywhere in-tree (SURVEY §2.5/§5:
+absent — Ray only orchestrates frameworks that implement it). This is the
+green-field TPU-native design: the sequence dim is sharded over a mesh axis
+("sp"), each device holds one Q block and rotates KV blocks around the ring
+with `lax.ppermute` (one ICI hop per step), accumulating attention with an
+online (flash-style) softmax — so sequence length scales linearly with the
+number of devices while HBM holds only one KV block at a time.
+
+Blockwise formulation follows the public ring-attention / blockwise-attention
+literature (see PAPERS.md); implementation is original.
+
+Layout: q, k, v are [B, S, H, Dh] with S sharded over axis "sp". Inside
+`shard_map` each device sees [B, S/p, H, Dh]. Causality is enforced with
+global position ids reconstructed from the ring step and `jax.lax.axis_index`.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def _pvary(x, axis_name):
+    """Mark a constant as device-varying over `axis_name` so it can carry
+    through a lax.scan under shard_map (JAX >= 0.7 vma tracking)."""
+    try:
+        return jax.lax.pcast(x, (axis_name,), to="varying")
+    except AttributeError:
+        return x
+
+
+def _block_update(q, k, v, o, m, l, q_off, k_off, causal, scale):
+    """One online-softmax accumulation step against a single KV block.
+
+    q: [B, Sq, H, Dh]   k,v: [B, Sk, H, Dh]
+    o: [B, Sq, H, Dh] f32 accumulator; m,l: [B, H, Sq] f32 running max/sum.
+    Returns updated (o, m, l).
+    """
+    logits = (
+        jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+        * scale
+    )
+    Sq, Sk = q.shape[1], k.shape[1]
+    if causal:
+        q_pos = q_off + jnp.arange(Sq)
+        k_pos = k_off + jnp.arange(Sk)
+        mask = q_pos[:, None] >= k_pos[None, :]  # [Sq, Sk]
+        logits = jnp.where(mask[None, None, :, :], logits, _NEG_INF)
+        pmask = mask[None, None, :, :].astype(jnp.float32)
+    else:
+        pmask = 1.0
+    m_new = jnp.maximum(m, logits.max(axis=-1))
+    # exp(finite - m_new) with fully-masked blocks handled by the explicit
+    # pmask multiply (exp(-1e30 - (-1e30)) = 1 would otherwise leak weight).
+    p = jnp.exp(logits - m_new[..., None]) * pmask
+    corr = jnp.exp(m - m_new)
+    l = l * corr + p.sum(axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    o = o * corr.transpose(0, 2, 1)[..., None] + pv
+    return o, m_new, l
+
+
+def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool):
+    """Per-device body (runs under shard_map). q,k,v: local [B, Sq, H, Dh]."""
+    B, Sq, H, Dh = q.shape
+    p = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    scale = 1.0 / math.sqrt(Dh)
+    perm = [(i, (i + 1) % p) for i in range(p)]
+
+    o = _pvary(jnp.zeros((B, Sq, H, Dh), jnp.float32), axis_name)
+    m = _pvary(jnp.full((B, H, Sq), _NEG_INF, jnp.float32), axis_name)
+    l = _pvary(jnp.zeros((B, H, Sq), jnp.float32), axis_name)
+    q_off = idx * Sq
+
+    def step(carry, t):
+        o, m, l, kb, vb = carry
+        # the KV block currently held arrived from device (idx - t) mod p
+        k_off = ((idx - t) % p) * Sq
+        o, m, l = _block_update(q, kb, vb, o, m, l, q_off, k_off, causal, scale)
+        kb = jax.lax.ppermute(kb, axis_name, perm)
+        vb = jax.lax.ppermute(vb, axis_name, perm)
+        return (o, m, l, kb, vb), None
+
+    (o, m, l, _, _), _ = jax.lax.scan(
+        step, (o, m, l, k, v), jnp.arange(p)
+    )
+    # causal rows always see their own position, so l > 0; guard anyway for
+    # the non-causal empty-block impossibility turning into NaN on refactor
+    l = jnp.maximum(l, 1e-30)
+    return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    *,
+    axis_name: str = "sp",
+    causal: bool = True,
+) -> jnp.ndarray:
+    """Sequence-parallel attention over `axis_name` of `mesh`.
+
+    q, k, v: [B, S, H, Dh] with S divisible by the axis size. Returns the
+    attention output in the same layout/sharding. Jit-safe (the shard_map is
+    traced into the caller's program, collectives ride ICI).
+    """
+    spec = P(None, axis_name, None, None)
+    fn = functools.partial(
+        _ring_attention_local, axis_name=axis_name, causal=causal
+    )
+    return jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )(q, k, v)
+
+
+def reference_attention(q, k, v, *, causal: bool = True) -> jnp.ndarray:
+    """Unsharded O(S^2) reference for tests. Same math, one block."""
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = (
+        jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+        * scale
+    )
+    if causal:
+        S = q.shape[1]
+        mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
+        logits = jnp.where(mask[None, None], logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32)).astype(
+        q.dtype
+    )

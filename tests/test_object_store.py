@@ -19,7 +19,7 @@ from ray_tpu.object_store import (
 
 
 def oid(i: int) -> bytes:
-    return i.to_bytes(4, "big") + os.urandom(16) if False else i.to_bytes(20, "big")
+    return i.to_bytes(20, "big")
 
 
 @pytest.fixture
