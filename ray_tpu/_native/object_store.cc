@@ -325,13 +325,12 @@ int64_t do_map(const char* name, bool create, uint64_t capacity, uint32_t max_ob
 }
 
 Mapping* get_mapping(int64_t h) {
+  // hold the lock across operator[] too: push_back may rewrite the deque's
+  // internal block map even though elements themselves never move; the
+  // returned Mapping* stays valid after unlock
   auto& ms = mappings();
-  size_t n;
-  {
-    std::lock_guard<std::mutex> g(mappings_mutex());
-    n = ms.size();
-  }
-  if (h < 0 || (size_t)h >= n || !ms[h].valid) return nullptr;
+  std::lock_guard<std::mutex> g(mappings_mutex());
+  if (h < 0 || (size_t)h >= ms.size() || !ms[h].valid) return nullptr;
   return &ms[h];
 }
 

@@ -1,0 +1,47 @@
+"""ray_tpu.data — streaming datasets over the core task/actor API.
+
+Reference: python/ray/data/ (Dataset, read_api, grouped_data, aggregate).
+"""
+
+from ray_tpu.data.block import BlockAccessor
+from ray_tpu.data.dataset import ActorPoolStrategy, Dataset
+from ray_tpu.data.grouped import (
+    AggregateFn,
+    Count,
+    Max,
+    Mean,
+    Min,
+    Std,
+    Sum,
+)
+from ray_tpu.data.io import (
+    from_arrow,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,  # noqa: A004
+    read_csv,
+    read_json,
+    read_parquet,
+)
+
+__all__ = [
+    "ActorPoolStrategy",
+    "AggregateFn",
+    "BlockAccessor",
+    "Count",
+    "Dataset",
+    "Max",
+    "Mean",
+    "Min",
+    "Std",
+    "Sum",
+    "from_arrow",
+    "from_items",
+    "from_numpy",
+    "from_pandas",
+    "range",
+    "read_csv",
+    "read_json",
+    "read_parquet",
+]

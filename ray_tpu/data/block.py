@@ -1,0 +1,150 @@
+"""Block representation for ray_tpu.data.
+
+Reference: python/ray/data/block.py (Block = pyarrow.Table, BlockAccessor).
+Canonical block format is a pyarrow.Table (zero-copy into the shm object
+store via Arrow IPC; zero-copy out to numpy for device feeds), same choice
+as the reference. Rows are plain dicts; batches convert to numpy / pandas /
+pyarrow on demand.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+
+def _normalize_rows(rows: List[Any]) -> pa.Table:
+    """Items -> table. Non-dict items land in the reference's magic
+    'item' column (python/ray/data/_internal/util.py)."""
+    if rows and isinstance(rows[0], dict):
+        cols: Dict[str, list] = {k: [] for k in rows[0]}
+        for r in rows:
+            for k in cols:
+                cols[k].append(r.get(k))
+        return pa.table({k: _to_array(v) for k, v in cols.items()})
+    return pa.table({"item": _to_array(list(rows))})
+
+
+def _to_array(values: list) -> pa.Array:
+    if values and isinstance(values[0], np.ndarray):
+        # tensor column: fixed-shape ndarray per row
+        flat = np.stack(values)
+        return pa.FixedSizeListArray.from_arrays(
+            pa.array(flat.reshape(flat.shape[0], -1).ravel()),
+            int(np.prod(flat.shape[1:])),
+        )
+    return pa.array(values)
+
+
+def block_from_rows(rows: List[Any]) -> pa.Table:
+    if not rows:
+        return pa.table({})
+    return _normalize_rows(rows)
+
+
+def block_from_batch(batch: Any) -> pa.Table:
+    """A user batch (dict of numpy arrays / pandas DataFrame / pyarrow Table /
+    list of rows) -> block."""
+    if isinstance(batch, pa.Table):
+        return batch
+    if isinstance(batch, dict):
+        cols = {}
+        for k, v in batch.items():
+            if isinstance(v, list):
+                cols[k] = _to_array(v)
+            else:
+                arr = np.asarray(v)
+                if arr.ndim > 1:
+                    # tensor column: keep per-row shape via fixed-size lists
+                    cols[k] = pa.FixedSizeListArray.from_arrays(
+                        pa.array(arr.reshape(arr.shape[0], -1).ravel()),
+                        int(np.prod(arr.shape[1:])),
+                    )
+                else:
+                    cols[k] = pa.array(arr)
+        return pa.table(cols)
+    try:
+        import pandas as pd
+
+        if isinstance(batch, pd.DataFrame):
+            return pa.Table.from_pandas(batch, preserve_index=False)
+    except ImportError:  # pragma: no cover
+        pass
+    if isinstance(batch, list):
+        return block_from_rows(batch)
+    raise TypeError(f"unsupported batch type: {type(batch)}")
+
+
+class BlockAccessor:
+    """Uniform view over a block (reference: BlockAccessor.for_block)."""
+
+    def __init__(self, block: pa.Table):
+        self._t = block
+
+    @staticmethod
+    def for_block(block: pa.Table) -> "BlockAccessor":
+        return BlockAccessor(block)
+
+    def num_rows(self) -> int:
+        return self._t.num_rows
+
+    def size_bytes(self) -> int:
+        return self._t.nbytes
+
+    def schema(self):
+        return self._t.schema
+
+    def to_arrow(self) -> pa.Table:
+        return self._t
+
+    def to_pandas(self):
+        return self._t.to_pandas()
+
+    def to_numpy(self) -> Dict[str, np.ndarray]:
+        out = {}
+        for name in self._t.column_names:
+            col = self._t.column(name)
+            if pa.types.is_fixed_size_list(col.type):
+                flat = col.combine_chunks().flatten().to_numpy(zero_copy_only=False)
+                out[name] = flat.reshape(self._t.num_rows, -1)
+            elif pa.types.is_list(col.type):
+                # equal-length list rows (e.g. tensor rows that round-tripped
+                # through python) stack back into a 2-D batch
+                rows = col.to_pylist()
+                try:
+                    out[name] = np.stack([np.asarray(r) for r in rows])
+                except ValueError:
+                    out[name] = np.asarray(rows, dtype=object)
+            else:
+                out[name] = col.to_numpy(zero_copy_only=False)
+        return out
+
+    def to_batch(self, batch_format: Optional[str]):
+        if batch_format in (None, "default", "numpy"):
+            return self.to_numpy()
+        if batch_format == "pandas":
+            return self.to_pandas()
+        if batch_format == "pyarrow":
+            return self._t
+        raise ValueError(f"unknown batch_format: {batch_format}")
+
+    def iter_rows(self) -> Iterator[dict]:
+        cols = self._t.column_names
+        if cols == ["item"]:
+            for v in self._t.column("item").to_pylist():
+                yield v
+            return
+        for row in self._t.to_pylist():
+            yield row
+
+    def slice(self, start: int, end: int) -> pa.Table:
+        return self._t.slice(start, end - start)
+
+
+def concat_blocks(blocks: List[pa.Table]) -> pa.Table:
+    blocks = [b for b in blocks if b.num_rows > 0]
+    if not blocks:
+        return pa.table({})
+    return pa.concat_tables(blocks, promote_options="default")

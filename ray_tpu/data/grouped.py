@@ -1,0 +1,156 @@
+"""GroupedData: hash-partitioned groupby aggregation.
+
+Reference: python/ray/data/grouped_data.py (GroupedData.count/sum/mean/...,
+AggregateFn). Map side hashes the key into n partitions; each reduce task
+runs pyarrow's native group_by over its partition — all groups with equal
+keys land in the same partition, so per-partition aggregates are exact.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from ray_tpu.data.block import BlockAccessor, concat_blocks
+from ray_tpu.data.dataset import Dataset, _AllToAllStage
+
+
+def _det_hash(v) -> int:
+    """Deterministic cross-process hash (Python's hash() is salted per
+    process — worker subprocesses would partition the same key
+    differently)."""
+    import zlib
+
+    return zlib.crc32(repr(v).encode())
+
+
+def _hash_partition(key: str):
+    """part_fn for hash exchanges: rows with equal keys land in the same
+    partition in every worker process."""
+
+    def part(block, n, _key=key):
+        if block.num_rows == 0:
+            return [block] * n
+        vals = block.column(_key).to_pylist()
+        h = np.array([_det_hash(v) % n for v in vals])
+        return [block.take(pa.array(np.nonzero(h == j)[0])) for j in range(n)]
+
+    return part
+
+
+class AggregateFn:
+    """Named aggregate over a column (reference: ray.data.aggregate.AggregateFn
+    family — Count/Sum/Min/Max/Mean/Std)."""
+
+    def __init__(self, kind: str, on: Optional[str] = None, alias: Optional[str] = None):
+        self.kind = kind
+        self.on = on
+        self.alias = alias or (f"{kind}({on})" if on else kind)
+
+
+def Count():
+    return AggregateFn("count")
+
+
+def Sum(on: str):
+    return AggregateFn("sum", on)
+
+
+def Min(on: str):
+    return AggregateFn("min", on)
+
+
+def Max(on: str):
+    return AggregateFn("max", on)
+
+
+def Mean(on: str):
+    return AggregateFn("mean", on)
+
+
+def Std(on: str):
+    return AggregateFn("stddev", on)
+
+
+_PA_AGG = {
+    "count": "count",
+    "sum": "sum",
+    "min": "min",
+    "max": "max",
+    "mean": "mean",
+    "stddev": "stddev",
+}
+
+
+class GroupedData:
+    def __init__(self, ds: Dataset, key: str):
+        self._ds = ds
+        self._key = key
+
+    def aggregate(self, *aggs: AggregateFn) -> Dataset:
+        key = self._key
+        n = max(self._ds.num_blocks(), 1)
+        agg_spec: List[Tuple[str, str, str]] = []
+        for a in aggs:
+            col = a.on if a.on else key
+            agg_spec.append((col, _PA_AGG[a.kind], a.alias))
+
+        def reduce(blocks, _key=key, _spec=tuple(agg_spec)):
+            t = concat_blocks(blocks)
+            if t.num_rows == 0:
+                return t
+            gb = t.group_by(_key)
+            res = gb.aggregate([(col, fn) for col, fn, _ in _spec])
+            # rename pyarrow's col_fn names to the requested aliases
+            names = list(res.column_names)
+            for col, fn, alias in _spec:
+                pa_name = f"{col}_{fn}"
+                if pa_name in names:
+                    names[names.index(pa_name)] = alias
+            return res.rename_columns(names)
+
+        return self._ds._with_stage(
+            _AllToAllStage("groupby", n, _hash_partition(key), reduce)
+        )
+
+    def count(self) -> Dataset:
+        return self.aggregate(Count())
+
+    def sum(self, on: str) -> Dataset:
+        return self.aggregate(Sum(on))
+
+    def min(self, on: str) -> Dataset:
+        return self.aggregate(Min(on))
+
+    def max(self, on: str) -> Dataset:
+        return self.aggregate(Max(on))
+
+    def mean(self, on: str) -> Dataset:
+        return self.aggregate(Mean(on))
+
+    def std(self, on: str) -> Dataset:
+        return self.aggregate(Std(on))
+
+    def map_groups(self, fn) -> Dataset:
+        """Apply fn(pandas.DataFrame) -> rows/DataFrame per group."""
+        key = self._key
+        n = max(self._ds.num_blocks(), 1)
+
+        def reduce(blocks, _key=key):
+            from ray_tpu.data.block import block_from_batch
+
+            t = concat_blocks(blocks)
+            if t.num_rows == 0:
+                return t
+            df = t.to_pandas()
+            outs = []
+            for _, group in df.groupby(_key, sort=False):
+                out = fn(group)
+                outs.append(block_from_batch(out))
+            return concat_blocks(outs)
+
+        return self._ds._with_stage(
+            _AllToAllStage("map_groups", n, _hash_partition(key), reduce)
+        )

@@ -1,0 +1,123 @@
+"""Datasource read/write for ray_tpu.data.
+
+Reference: python/ray/data/read_api.py (range/from_items/read_parquet/...)
+and _internal/datasource/ (parquet/csv/json datasources). Reads produce one
+remote task per file/shard so IO parallelizes through the scheduler like any
+other work; blocks land in the object store.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Any, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+import ray_tpu
+from ray_tpu.data.block import block_from_rows
+from ray_tpu.data.dataset import Dataset
+
+
+@ray_tpu.remote
+def _read_shard(kind: str, path_or_args: Any) -> pa.Table:
+    if kind == "range":
+        start, stop = path_or_args
+        return pa.table({"id": pa.array(np.arange(start, stop))})
+    if kind == "parquet":
+        import pyarrow.parquet as pq
+
+        return pq.read_table(path_or_args)
+    if kind == "csv":
+        from pyarrow import csv as pacsv
+
+        return pacsv.read_csv(path_or_args)
+    if kind == "json":
+        from pyarrow import json as pajson
+
+        return pajson.read_json(path_or_args)
+    raise ValueError(kind)
+
+
+def _expand_paths(paths) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(glob.glob(os.path.join(p, "*"))))
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(glob.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files match {paths}")
+    return out
+
+
+_range = range  # the module-level read API shadows the builtin below
+
+
+def range(n: int, *, parallelism: int = -1) -> Dataset:  # noqa: A001
+    if parallelism <= 0:
+        parallelism = min(200, max(1, n // 1000)) if n else 1
+    cuts = [n * i // parallelism for i in _range(parallelism + 1)]
+    refs = [
+        _read_shard.remote("range", (cuts[i], cuts[i + 1]))
+        for i in _range(parallelism)
+    ]
+    return Dataset(refs)
+
+
+def from_items(items: List[Any], *, parallelism: int = 4) -> Dataset:
+    n = len(items)
+    parallelism = max(1, min(parallelism, n or 1))
+    cuts = [n * i // parallelism for i in _range(parallelism + 1)]
+    refs = [
+        ray_tpu.put(block_from_rows(items[cuts[i]:cuts[i + 1]]))
+        for i in _range(parallelism)
+    ]
+    return Dataset(refs)
+
+
+def from_numpy(arr: np.ndarray, *, column: str = "data") -> Dataset:
+    return from_items([{column: row} for row in arr])
+
+
+def from_pandas(df) -> Dataset:
+    return Dataset([ray_tpu.put(pa.Table.from_pandas(df, preserve_index=False))])
+
+
+def from_arrow(table: pa.Table) -> Dataset:
+    return Dataset([ray_tpu.put(table)])
+
+
+def read_parquet(paths, **kwargs) -> Dataset:
+    return Dataset([_read_shard.remote("parquet", p) for p in _expand_paths(paths)])
+
+
+def read_csv(paths, **kwargs) -> Dataset:
+    return Dataset([_read_shard.remote("csv", p) for p in _expand_paths(paths)])
+
+
+def read_json(paths, **kwargs) -> Dataset:
+    return Dataset([_read_shard.remote("json", p) for p in _expand_paths(paths)])
+
+
+def _write_blocks(ds: Dataset, path: str, fmt: str) -> None:
+    os.makedirs(path, exist_ok=True)
+    for i, block in enumerate(ds.iter_blocks()):
+        if block.num_rows == 0:
+            continue
+        fp = os.path.join(path, f"part-{i:05d}.{fmt}")
+        if fmt == "parquet":
+            import pyarrow.parquet as pq
+
+            pq.write_table(block, fp)
+        elif fmt == "csv":
+            from pyarrow import csv as pacsv
+
+            pacsv.write_csv(block, fp)
+        elif fmt == "json":
+            block.to_pandas().to_json(fp, orient="records", lines=True)

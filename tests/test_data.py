@@ -1,0 +1,279 @@
+"""ray_tpu.data tests (reference test style: python/ray/data/tests/ run
+against a ray_start_regular local cluster; here the local_ray fixture)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def data(local_ray):
+    from ray_tpu import data
+
+    return data
+
+
+def test_range_count_take(data):
+    ds = data.range(100, parallelism=4)
+    assert ds.count() == 100
+    assert ds.take(5) == [{"id": 0}, {"id": 1}, {"id": 2}, {"id": 3}, {"id": 4}]
+    assert ds.num_blocks() == 4
+
+
+def test_from_items_roundtrip(data):
+    items = [{"x": i, "y": str(i)} for i in range(10)]
+    ds = data.from_items(items)
+    assert ds.take_all() == items
+
+
+def test_from_items_scalars(data):
+    ds = data.from_items([1, 2, 3])
+    assert ds.take_all() == [1, 2, 3]
+
+
+def test_map(data):
+    ds = data.range(10, parallelism=2).map(lambda r: {"id": r["id"] * 2})
+    assert [r["id"] for r in ds.take_all()] == [i * 2 for i in range(10)]
+
+
+def test_filter_flat_map_fusion(data):
+    ds = (
+        data.range(10, parallelism=2)
+        .filter(lambda r: r["id"] % 2 == 0)
+        .flat_map(lambda r: [r, r])
+    )
+    # consecutive per-block transforms fuse into one stage
+    assert len(ds._stages) == 1
+    vals = sorted(r["id"] for r in ds.take_all())
+    assert vals == sorted([i for i in range(0, 10, 2)] * 2)
+
+
+def test_map_batches_numpy(data):
+    ds = data.range(100, parallelism=4).map_batches(
+        lambda b: {"id": b["id"] * 10}, batch_format="numpy"
+    )
+    assert sorted(r["id"] for r in ds.take_all()) == [i * 10 for i in range(100)]
+
+
+def test_map_batches_pandas(data):
+    def f(df):
+        df["z"] = df["id"] + 1
+        return df
+
+    ds = data.range(5, parallelism=1).map_batches(f, batch_format="pandas")
+    assert [r["z"] for r in ds.take_all()] == [1, 2, 3, 4, 5]
+
+
+def test_map_batches_batch_size(data):
+    sizes = []
+
+    def f(b):
+        sizes.append(len(b["id"]))
+        return b
+
+    data.range(10, parallelism=1).map_batches(f, batch_size=3).count()
+    assert max(sizes) <= 3
+
+
+def test_map_batches_actor_pool(data):
+    class AddModel:
+        def __init__(self):
+            self.offset = 1000  # stateful init once per actor
+
+        def __call__(self, batch):
+            return {"id": batch["id"] + self.offset}
+
+    ds = data.range(20, parallelism=4).map_batches(
+        AddModel, compute=data.ActorPoolStrategy(size=2)
+    )
+    assert sorted(r["id"] for r in ds.take_all()) == [i + 1000 for i in range(20)]
+
+
+def test_repartition(data):
+    ds = data.range(100, parallelism=10).repartition(3)
+    blocks = list(ds.iter_blocks())
+    assert len(blocks) == 3
+    assert sum(b.num_rows for b in blocks) == 100
+
+
+def test_random_shuffle_preserves_multiset(data):
+    ds = data.range(50, parallelism=5).random_shuffle(seed=0)
+    vals = [r["id"] for r in ds.take_all()]
+    assert sorted(vals) == list(range(50))
+    assert vals != list(range(50))  # actually shuffled
+
+
+def test_sort(data):
+    rng = np.random.default_rng(0)
+    items = [{"v": int(x)} for x in rng.permutation(100)]
+    ds = data.from_items(items, parallelism=4).sort("v")
+    assert [r["v"] for r in ds.take_all()] == list(range(100))
+
+
+def test_sort_descending(data):
+    ds = data.from_items([{"v": i} for i in range(10)], parallelism=2).sort(
+        "v", descending=True
+    )
+    assert [r["v"] for r in ds.take_all()] == list(range(9, -1, -1))
+
+
+def test_groupby_count_sum(data):
+    items = [{"k": i % 3, "v": i} for i in range(12)]
+    ds = data.from_items(items, parallelism=3)
+    out = {r["k"]: r["count"] for r in ds.groupby("k").count().take_all()}
+    assert out == {0: 4, 1: 4, 2: 4}
+    sums = {r["k"]: r["sum(v)"] for r in ds.groupby("k").sum("v").take_all()}
+    assert sums == {
+        k: sum(i for i in range(12) if i % 3 == k) for k in range(3)
+    }
+
+
+def test_groupby_map_groups(data):
+    items = [{"k": i % 2, "v": i} for i in range(8)]
+    ds = data.from_items(items, parallelism=2)
+
+    def top1(df):
+        return df.nlargest(1, "v")
+
+    out = sorted(r["v"] for r in ds.groupby("k").map_groups(top1).take_all())
+    assert out == [6, 7]
+
+
+def test_limit(data):
+    ds = data.range(100, parallelism=10).limit(7)
+    assert ds.count() == 7
+
+
+def test_union_zip(data):
+    a = data.range(5, parallelism=1)
+    b = data.range(5, parallelism=1)
+    assert a.union(b).count() == 10
+    z = a.zip(b.map(lambda r: {"other": r["id"] * 2}))
+    rows = z.take_all()
+    assert all(r["other"] == 2 * r["id"] for r in rows)
+
+
+def test_split(data):
+    parts = data.range(30, parallelism=3).split(3)
+    assert len(parts) == 3
+    assert sum(p.count() for p in parts) == 30
+
+
+def test_add_drop_select_columns(data):
+    ds = data.range(5, parallelism=1).add_column(
+        "sq", lambda b: b["id"] ** 2
+    )
+    assert [r["sq"] for r in ds.take_all()] == [0, 1, 4, 9, 16]
+    assert ds.select_columns(["sq"]).take(1) == [{"sq": 0}]
+    assert "sq" not in ds.drop_columns(["sq"]).take(1)[0]
+
+
+def test_iter_batches(data):
+    ds = data.range(10, parallelism=2)
+    batches = list(ds.iter_batches(batch_size=4, batch_format="numpy"))
+    assert sum(len(b["id"]) for b in batches) == 10
+    assert all(isinstance(b["id"], np.ndarray) for b in batches)
+
+
+def test_schema_and_stats(data):
+    ds = data.range(5, parallelism=1)
+    assert "id" in [f.name for f in ds.schema()]
+    assert "blocks" in ds.stats()
+
+
+def test_parquet_roundtrip(data, tmp_path):
+    ds = data.range(50, parallelism=2).map(lambda r: {"id": r["id"], "s": str(r["id"])})
+    path = str(tmp_path / "pq")
+    ds.write_parquet(path)
+    back = data.read_parquet(path)
+    assert back.count() == 50
+    assert sorted(r["id"] for r in back.take_all()) == list(range(50))
+
+
+def test_csv_roundtrip(data, tmp_path):
+    ds = data.from_items([{"a": i, "b": i * 2} for i in range(10)])
+    path = str(tmp_path / "csv")
+    ds.write_csv(path)
+    back = data.read_csv(path)
+    assert back.count() == 10
+
+
+def test_json_roundtrip(data, tmp_path):
+    ds = data.from_items([{"a": i} for i in range(10)])
+    path = str(tmp_path / "json")
+    ds.write_json(path)
+    back = data.read_json(path)
+    assert back.count() == 10
+
+
+def test_from_numpy_tensor_column(data):
+    arr = np.arange(12, dtype=np.float32).reshape(6, 2)
+    ds = data.from_numpy(arr)
+    batch = ds.take_batch(6)
+    assert batch["data"].shape == (6, 2)
+    np.testing.assert_array_equal(batch["data"], arr)
+
+
+def test_from_pandas_to_pandas(data):
+    import pandas as pd
+
+    df = pd.DataFrame({"x": [1, 2, 3]})
+    out = data.from_pandas(df).to_pandas()
+    assert list(out["x"]) == [1, 2, 3]
+
+
+def test_materialize(data):
+    calls = []
+
+    def f(r):
+        calls.append(1)
+        return r
+
+    ds = data.range(10, parallelism=2).map(f).materialize()
+    n0 = len(calls)
+    ds.count()
+    ds.count()
+    assert len(calls) == n0  # no re-execution after materialize
+
+
+def test_limit_position_semantics(data):
+    # limit BEFORE flat_map: truncate first, then duplicate
+    ds = data.range(10, parallelism=2).limit(2).flat_map(lambda r: [r, r])
+    assert sorted(r["id"] for r in ds.take_all()) == [0, 0, 1, 1]
+    # limit AFTER flat_map caps the output
+    ds2 = data.range(10, parallelism=2).flat_map(lambda r: [r, r]).limit(2)
+    assert ds2.count() == 2
+
+
+def test_sort_globally_ordered_after_chained_map(data):
+    items = [{"v": int(x)} for x in np.random.default_rng(1).permutation(200)]
+    ds = (
+        data.from_items(items, parallelism=4)
+        .sort("v")
+        .map(lambda r: {"v": r["v"]})
+    )
+    assert [r["v"] for r in ds.take_all()] == list(range(200))
+
+
+def test_sort_string_keys(data):
+    items = [{"s": f"key{i:03d}"} for i in range(50)]
+    np.random.default_rng(2).shuffle(items)
+    ds = data.from_items(items, parallelism=3).sort("s")
+    assert [r["s"] for r in ds.take_all()] == [f"key{i:03d}" for i in range(50)]
+
+
+def test_groupby_string_keys_deterministic(data):
+    items = [{"k": f"g{i % 5}", "v": 1} for i in range(25)]
+    out = {
+        r["k"]: r["count"]
+        for r in data.from_items(items, parallelism=5).groupby("k").count().take_all()
+    }
+    assert out == {f"g{j}": 5 for j in range(5)}
+
+
+def test_map_batches_tensor_column_roundtrip(data):
+    arr = np.arange(24, dtype=np.float32).reshape(12, 2)
+    ds = data.from_numpy(arr).map_batches(lambda b: {"data": b["data"] * 2})
+    batch = ds.take_batch(12)
+    np.testing.assert_array_equal(batch["data"], arr * 2)
