@@ -1,0 +1,23 @@
+"""ray_tpu.air — shared config/result/checkpoint types for Train and Tune.
+
+Reference: python/ray/air/ (config.py, result.py) and
+python/ray/train/_checkpoint.py.
+"""
+
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import (
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.air.result import Result
+
+__all__ = [
+    "Checkpoint",
+    "CheckpointConfig",
+    "FailureConfig",
+    "RunConfig",
+    "ScalingConfig",
+    "Result",
+]

@@ -102,6 +102,7 @@ class ClusterClient:
             "owner": self.worker_id,
             "actor_id": spec.actor_id,
             "actor_creation": spec.actor_creation,
+            "max_concurrency": spec.max_concurrency,
             "retries_left": spec.retries_left,
             "strategy": {
                 "kind": spec.strategy.kind,

@@ -22,6 +22,7 @@ from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.cluster.rpc import RpcClient
 
 _actor_instances = {}
+_actor_concurrency = {}
 
 
 def _resolve(client: RpcClient, obj):
@@ -57,6 +58,7 @@ def _execute(client: RpcClient, t: dict):
         if t.get("actor_creation"):
             cls = spec["func"]
             _actor_instances[t["actor_id"]] = cls(*args, **kwargs)
+            _actor_concurrency[t["actor_id"]] = int(t.get("max_concurrency", 1))
             values = [t["actor_id"]]
         elif t.get("actor_id"):
             inst = _actor_instances.get(t["actor_id"])
@@ -100,9 +102,32 @@ def main():  # pragma: no cover - runs as a subprocess
     client.subscribe("run_task", tasks.put)
     client.on_close = lambda: os._exit(0)  # daemon gone -> exit
     client.call("worker_ready", {"worker_id": worker_id, "pid": os.getpid()})
+    # Threaded-actor pool (reference: max_concurrency>1): methods of an actor
+    # created with max_concurrency>1 may overlap/block on each other.
+    from concurrent.futures import ThreadPoolExecutor
+
+    def _pooled(t):
+        # Inline-path semantics: an unreported failure (e.g. daemon RPC loss)
+        # kills the worker so the daemon resolves the task as WORKER_DIED —
+        # never leave the driver hanging on an unobserved Future.
+        try:
+            _execute(client, t)
+        except BaseException:
+            traceback.print_exc()
+            os._exit(1)
+
+    pool = None
     while True:
         t = tasks.get()
-        _execute(client, t)
+        mc = _actor_concurrency.get(t.get("actor_id") or "", 1)
+        if mc > 1 and not t.get("actor_creation"):
+            if pool is None:
+                # sized to the actor's declared concurrency (one actor per
+                # worker process, so one pool)
+                pool = ThreadPoolExecutor(max_workers=mc)
+            pool.submit(_pooled, t)
+        else:
+            _execute(client, t)
 
 
 if __name__ == "__main__":

@@ -417,6 +417,15 @@ class LocalRuntime:
             with self._lock:
                 self._running.pop(creation_spec.task_id, None)
 
+        # Threaded actors (reference: max_concurrency>1 runs methods on a
+        # per-actor thread pool, core_worker concurrency groups): methods may
+        # overlap and block on each other — needed by barrier-style actors
+        # like the train report bus. Daemon threads gated by a semaphore, NOT
+        # a ThreadPoolExecutor: its atexit join would deadlock interpreter
+        # exit on methods blocked in a barrier that never completes.
+        sem: Optional[threading.Semaphore] = None
+        if creation_spec.max_concurrency > 1:
+            sem = threading.Semaphore(creation_spec.max_concurrency)
         while True:
             with st.cv:
                 while not st.mailbox and not st.dead:
@@ -426,35 +435,53 @@ class LocalRuntime:
                 if st.dead:
                     break
                 spec = st.mailbox.popleft()
-            start = time.time()
-            try:
-                args, kwargs = self._resolve_args(spec)
-                method = getattr(st.instance, spec.method_name)
-                value = method(*args, **kwargs)
-                self._store_results(spec, value)
-                status = "FINISHED"
-            except BaseException as e:
-                tb = traceback.format_exc()
-                self._store_error(
-                    spec, TaskError(f"actor method {spec.method_name} failed: {e!r}", tb)
-                )
-                status = "FAILED"
-            with self._lock:
-                self._running.pop(spec.task_id, None)
-            self._task_events.append(
-                {
-                    "task_id": spec.task_id,
-                    "name": spec.name,
-                    "start": start,
-                    "end": time.time(),
-                    "status": status,
-                    "node": self.node_id,
-                    "actor_id": st.actor_id,
-                }
-            )
+            if sem is None:
+                self._run_actor_method(st, spec)
+            else:
+                sem.acquire()
+
+                def _run(spec=spec):
+                    try:
+                        self._run_actor_method(st, spec)
+                    finally:
+                        sem.release()
+
+                threading.Thread(
+                    target=_run, daemon=True,
+                    name=f"raytpu-actor-{st.actor_id[:8]}-mc",
+                ).start()
         # drain mailbox with death errors
         self._fail_actor(st, creation_spec=None)
         self._release_resources(st.node_idx, st.demand)
+
+    def _run_actor_method(self, st: _ActorState, spec: TaskSpec):
+        _context.actor_id = st.actor_id
+        start = time.time()
+        try:
+            args, kwargs = self._resolve_args(spec)
+            method = getattr(st.instance, spec.method_name)
+            value = method(*args, **kwargs)
+            self._store_results(spec, value)
+            status = "FINISHED"
+        except BaseException as e:
+            tb = traceback.format_exc()
+            self._store_error(
+                spec, TaskError(f"actor method {spec.method_name} failed: {e!r}", tb)
+            )
+            status = "FAILED"
+        with self._lock:
+            self._running.pop(spec.task_id, None)
+        self._task_events.append(
+            {
+                "task_id": spec.task_id,
+                "name": spec.name,
+                "start": start,
+                "end": time.time(),
+                "status": status,
+                "node": self.node_id,
+                "actor_id": st.actor_id,
+            }
+        )
 
     def _enqueue_actor_task(self, spec: TaskSpec):
         # Actor method calls consume no scheduler resources; the actor holds

@@ -1,0 +1,57 @@
+"""ray_tpu.train — distributed training orchestration.
+
+Reference: python/ray/train/ (TorchTrainer, DataParallelTrainer,
+train.report/get_context/get_checkpoint, Checkpoint, ScalingConfig/RunConfig).
+The flagship here is JaxTrainer: worker-group actors each running one jitted
+SPMD program over a mesh (SURVEY §3.5 — the framework orchestrates, the step
+function owns the device).
+"""
+
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import (
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.air.result import Result
+from ray_tpu.train.backend_executor import Backend, BackendExecutor
+from ray_tpu.train.jax_trainer import JaxBackend, JaxTrainer
+from ray_tpu.train.jax_utils import (
+    load_pytree,
+    prepare_data_shard,
+    prepare_mesh,
+    save_pytree,
+)
+from ray_tpu.train.session import (
+    TrainContext,
+    get_checkpoint,
+    get_context,
+    report,
+)
+from ray_tpu.train.trainer import DataParallelTrainer, TrainingFailedError
+from ray_tpu.train.worker_group import WorkerGroup
+
+__all__ = [
+    "Backend",
+    "BackendExecutor",
+    "Checkpoint",
+    "CheckpointConfig",
+    "DataParallelTrainer",
+    "FailureConfig",
+    "JaxBackend",
+    "JaxTrainer",
+    "Result",
+    "RunConfig",
+    "ScalingConfig",
+    "TrainContext",
+    "TrainingFailedError",
+    "WorkerGroup",
+    "get_checkpoint",
+    "get_context",
+    "load_pytree",
+    "prepare_data_shard",
+    "prepare_mesh",
+    "report",
+    "save_pytree",
+]

@@ -1,0 +1,68 @@
+"""JaxTrainer: the flagship trainer — SPMD training over a device mesh.
+
+Reference analog: python/ray/train/torch/torch_trainer.py (TorchTrainer).
+Where TorchTrainer rendezvouses torch.distributed NCCL process groups, the
+JaxBackend's job is jax.distributed coordination across *hosts*; within a
+host all parallelism (dp/tp/pp/sp) is compiled into the worker's program via
+shardings (ray_tpu.parallel), so a single-host JaxTrainer typically runs ONE
+worker owning the whole mesh.
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import List, Optional
+
+from ray_tpu.air.config import ScalingConfig
+from ray_tpu.train.backend_executor import Backend
+from ray_tpu.train.trainer import DataParallelTrainer
+from ray_tpu.train.worker_group import WorkerGroup
+
+
+class JaxBackend(Backend):
+    """Multi-host rendezvous: pick rank-0's host as coordinator and call
+    jax.distributed.initialize on every worker (reference analog:
+    _TorchBackend.on_start setting MASTER_ADDR/PORT then
+    init_process_group)."""
+
+    def __init__(self, coordinator_port: int = 7621,
+                 distributed: Optional[bool] = None):
+        self.coordinator_port = coordinator_port
+        # None = auto: only initialize jax.distributed when workers span
+        # multiple nodes (single-node SPMD needs no host coordination).
+        self.distributed = distributed
+
+    def on_start(self, worker_group: WorkerGroup, worker_infos: List[dict]):
+        nodes = {info["node_id"] for info in worker_infos}
+        dist = self.distributed
+        if dist is None:
+            dist = len(nodes) > 1
+        if not dist:
+            return
+        coord = f"{worker_infos[0]['hostname']}:{self.coordinator_port}"
+        n = len(worker_infos)
+
+        def _init_dist(coord_addr, num_procs, rank):
+            import jax
+
+            jax.distributed.initialize(
+                coordinate_address=coord_addr,
+                num_processes=num_procs,
+                process_id=rank,
+            )
+            return True
+
+        futs = [
+            w.run.remote(_init_dist, coord, n, rank)
+            for rank, w in enumerate(worker_group.workers)
+        ]
+        import ray_tpu
+
+        ray_tpu.get(futs)
+
+
+class JaxTrainer(DataParallelTrainer):
+    def __init__(self, train_loop_per_worker, *, jax_backend: Optional[JaxBackend] = None,
+                 **kwargs):
+        kwargs.setdefault("backend", jax_backend or JaxBackend())
+        super().__init__(train_loop_per_worker, **kwargs)

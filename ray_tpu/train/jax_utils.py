@@ -1,0 +1,83 @@
+"""JAX-side training utilities: mesh preparation and pytree checkpointing.
+
+Reference analog: python/ray/train/torch/train_loop_utils.py
+(prepare_model/prepare_data_loader wrap torch DDP + CUDA placement). The
+TPU-native equivalents operate on meshes and pytrees instead: the worker's
+"DDP wrap" is a sharding annotation, and gradient sync is compiled into the
+SPMD program by XLA — there is no runtime hook to install.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ray_tpu.air.checkpoint import Checkpoint
+
+
+def prepare_mesh(axis_names: Sequence[str] = ("dp",),
+                 axis_sizes: Optional[Sequence[int]] = None):
+    """Build a Mesh over this worker's visible devices.
+
+    Single-host: all local devices. Multi-host (after
+    jax.distributed.initialize by JaxBackend): jax.devices() is global, so
+    the same call yields the cluster mesh — identical worker code either way,
+    which is the point of SPMD.
+    """
+    import jax
+    from ray_tpu.parallel.mesh import make_mesh
+
+    devices = jax.devices()
+    if axis_sizes is None:
+        sizes = [1] * len(axis_names)
+        sizes[0] = len(devices)
+        axis_sizes = sizes
+    return make_mesh(tuple(axis_names), sizes=tuple(axis_sizes), devices=devices)
+
+
+def prepare_data_shard(array, mesh, axis: str = "dp"):
+    """Shard a host batch over the mesh's data axis (the analog of the
+    reference's DistributedSampler: each rank sees its slice, but here the
+    slicing is a device_put with a sharding, zero host-side bookkeeping)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    spec = [None] * array.ndim
+    spec[0] = axis
+    return jax.device_put(array, NamedSharding(mesh, P(*spec)))
+
+
+# ----------------------------------------------------------- pytree ckpts
+
+_TREE_FILE = "pytree_structure.pkl"
+_ARRS_FILE = "pytree_leaves.npz"
+
+
+def save_pytree(tree: Any, directory: str) -> Checkpoint:
+    """Write a jax/numpy pytree as npz + treedef; host-side, device-agnostic."""
+    import jax
+
+    os.makedirs(directory, exist_ok=True)
+    leaves, treedef = jax.tree.flatten(tree)
+    host_leaves = [np.asarray(leaf) for leaf in leaves]
+    np.savez(
+        os.path.join(directory, _ARRS_FILE),
+        **{f"leaf_{i}": a for i, a in enumerate(host_leaves)},
+    )
+    with open(os.path.join(directory, _TREE_FILE), "wb") as f:
+        pickle.dump(treedef, f)
+    return Checkpoint.from_directory(directory)
+
+
+def load_pytree(checkpoint: Checkpoint) -> Any:
+    import jax
+
+    with checkpoint.as_directory() as d:
+        with open(os.path.join(d, _TREE_FILE), "rb") as f:
+            treedef = pickle.load(f)
+        data = np.load(os.path.join(d, _ARRS_FILE))
+        leaves = [data[f"leaf_{i}"] for i in range(len(data.files))]
+    return jax.tree.unflatten(treedef, leaves)
