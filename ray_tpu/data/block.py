@@ -19,10 +19,17 @@ def _normalize_rows(rows: List[Any]) -> pa.Table:
     """Items -> table. Non-dict items land in the reference's magic
     'item' column (python/ray/data/_internal/util.py)."""
     if rows and isinstance(rows[0], dict):
-        cols: Dict[str, list] = {k: [] for k in rows[0]}
+        if not all(isinstance(r, dict) for r in rows):
+            raise TypeError("cannot mix dict and non-dict items in one block")
+        # column set = union across ALL rows (missing values become null)
+        keys: List[str] = []
+        seen = set()
         for r in rows:
-            for k in cols:
-                cols[k].append(r.get(k))
+            for k in r:
+                if k not in seen:
+                    seen.add(k)
+                    keys.append(k)
+        cols = {k: [r.get(k) for r in rows] for k in keys}
         return pa.table({k: _to_array(v) for k, v in cols.items()})
     return pa.table({"item": _to_array(list(rows))})
 

@@ -38,13 +38,31 @@ def _map_block(fn, block):
 
 
 @ray_tpu.remote
-def _partition_block(part_fn, n, block):
-    """Map side of an exchange: split one block into n partition blocks."""
-    return tuple(part_fn(block, n))
+def _partition_block(part_fn, n, idx, block):
+    """Map side of an exchange: split one block into n partition blocks.
+    With n == 1 the single block is returned bare (num_returns=1 stores the
+    return value itself, not a 1-tuple)."""
+    if getattr(part_fn, "_wants_index", False):
+        parts = list(part_fn(block, n, idx))
+    else:
+        parts = list(part_fn(block, n))
+    return parts[0] if n == 1 else tuple(parts)
 
 
 @ray_tpu.remote
-def _reduce_blocks(reduce_fn, *parts):
+def _count_rows(block):
+    return BlockAccessor(block).num_rows()
+
+
+@ray_tpu.remote
+def _slice_block(block, start, stop):
+    return BlockAccessor(block).slice(start, stop)
+
+
+@ray_tpu.remote
+def _reduce_blocks(reduce_fn, idx, *parts):
+    if getattr(reduce_fn, "_wants_index", False):
+        return reduce_fn(list(parts), idx)
     return reduce_fn(list(parts))
 
 
@@ -156,15 +174,15 @@ def _execute_all_to_all(refs: List, stage: _AllToAllStage) -> List:
     if stage.prepare is not None:
         part_fn = stage.prepare(refs)
     parts = [
-        _partition_block.options(num_returns=n).remote(part_fn, n, ref)
-        for ref in refs
+        _partition_block.options(num_returns=n).remote(part_fn, n, i, ref)
+        for i, ref in enumerate(refs)
     ]
     if n == 1:
         parts = [[p] for p in parts]
     out = []
     for j in range(n):
         out.append(
-            _reduce_blocks.remote(stage.reduce_fn, *[p[j] for p in parts])
+            _reduce_blocks.remote(stage.reduce_fn, j, *[p[j] for p in parts])
         )
     return out
 
@@ -179,6 +197,9 @@ class Dataset:
     def __init__(self, block_refs: List, stages: Optional[List] = None):
         self._input_refs = block_refs
         self._stages = stages or []
+        # set by union(): input blocks come from the parents' pipelines,
+        # executed lazily at consumption time
+        self._parents: Optional[List["Dataset"]] = None
 
     # ------------------------------------------------------------- transforms
 
@@ -188,9 +209,14 @@ class Dataset:
             fused = stages[-1].fuse(stage)
             if fused is not None:
                 stages[-1] = fused
-                return Dataset(self._input_refs, stages)
+                return self._copy_with(stages)
         stages.append(stage)
-        return Dataset(self._input_refs, stages)
+        return self._copy_with(stages)
+
+    def _copy_with(self, stages) -> "Dataset":
+        ds = Dataset(self._input_refs, stages)
+        ds._parents = self._parents
+        return ds
 
     def map(self, fn: Callable[[dict], dict]) -> "Dataset":
         def _map(block):
@@ -234,10 +260,13 @@ class Dataset:
         def _apply(callable_fn, block):
             acc = BlockAccessor(block)
             nrows = acc.num_rows()
-            size = batch_size or max(nrows, 1)
+            if nrows == 0:
+                # never hand the user fn a schema-less empty batch
+                return block
+            size = batch_size or nrows
             outs = []
-            for s in range(0, max(nrows, 1), size):
-                sub = acc.slice(s, min(s + size, nrows)) if nrows else block
+            for s in range(0, nrows, size):
+                sub = acc.slice(s, min(s + size, nrows))
                 out = callable_fn(BlockAccessor(sub).to_batch(batch_format))
                 outs.append(block_from_batch(out))
             return concat_blocks(outs)
@@ -288,8 +317,12 @@ class Dataset:
     def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
         n = max(len(self._input_refs), 1)
 
-        def part(block, n, _seed=seed):
-            rng = np.random.default_rng(_seed)
+        def part(block, n, idx, _seed=seed):
+            # seed salted per block index: every map task draws an
+            # independent stream (reference: shuffle ops seed per task)
+            rng = np.random.default_rng(
+                None if _seed is None else (_seed, 0, idx)
+            )
             acc = BlockAccessor(block)
             rows = acc.num_rows()
             assign = rng.integers(0, n, rows)
@@ -298,12 +331,18 @@ class Dataset:
                 t.take(pa.array(np.nonzero(assign == j)[0])) for j in range(n)
             ]
 
-        def reduce(blocks, _seed=seed):
+        part._wants_index = True
+
+        def reduce(blocks, idx, _seed=seed):
             t = concat_blocks(blocks)
-            rng = np.random.default_rng(None if _seed is None else _seed + 1)
+            rng = np.random.default_rng(
+                None if _seed is None else (_seed, 1, idx)
+            )
             if t.num_rows:
                 t = t.take(pa.array(rng.permutation(t.num_rows)))
             return t
+
+        reduce._wants_index = True
 
         return self._with_stage(_AllToAllStage("random_shuffle", n, part, reduce))
 
@@ -374,9 +413,10 @@ class Dataset:
         return GroupedData(self, key)
 
     def union(self, other: "Dataset") -> "Dataset":
-        return Dataset(
-            list(self._materialize_refs()) + list(other._materialize_refs())
-        )
+        """Lazy: neither input pipeline executes until the union is consumed."""
+        ds = Dataset([])
+        ds._parents = [self, other]
+        return ds
 
     def zip(self, other: "Dataset") -> "Dataset":
         left = concat_blocks(ray_tpu.get(self._materialize_refs()))
@@ -401,7 +441,12 @@ class Dataset:
 
     def _execute_refs(self) -> Iterator:
         window = DEFAULT_IN_FLIGHT
-        refs: Iterator = iter(self._input_refs)
+        if self._parents is not None:
+            refs: Iterator = (
+                r for p in self._parents for r in p._execute_refs()
+            )
+        else:
+            refs = iter(self._input_refs)
         for stage in self._stages:
             if isinstance(stage, _MapStage):
                 refs = _execute_map(refs, stage, window)
@@ -415,17 +460,18 @@ class Dataset:
 
     @staticmethod
     def _apply_limit(refs, n):
+        # count/slice remotely: only the row count crosses to the driver,
+        # never the block contents (reference: limit uses block metadata)
         taken = 0
         for ref in refs:
             if taken >= n:
                 break
-            block = ray_tpu.get(ref)
-            rows = BlockAccessor(block).num_rows()
+            rows = ray_tpu.get(_count_rows.remote(ref))
             if taken + rows <= n:
                 taken += rows
                 yield ref
             else:
-                yield ray_tpu.put(BlockAccessor(block).slice(0, n - taken))
+                yield _slice_block.remote(ref, 0, n - taken)
                 taken = n
 
     def _materialize_refs(self) -> List:
@@ -473,6 +519,8 @@ class Dataset:
         return None
 
     def num_blocks(self) -> int:
+        if self._parents is not None:
+            return sum(p.num_blocks() for p in self._parents)
         return len(self._input_refs)
 
     def to_pandas(self):

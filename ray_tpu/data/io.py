@@ -21,22 +21,23 @@ from ray_tpu.data.dataset import Dataset
 
 
 @ray_tpu.remote
-def _read_shard(kind: str, path_or_args: Any) -> pa.Table:
+def _read_shard(kind: str, path_or_args: Any, kwargs: dict = None) -> pa.Table:
+    kwargs = kwargs or {}
     if kind == "range":
         start, stop = path_or_args
         return pa.table({"id": pa.array(np.arange(start, stop))})
     if kind == "parquet":
         import pyarrow.parquet as pq
 
-        return pq.read_table(path_or_args)
+        return pq.read_table(path_or_args, **kwargs)
     if kind == "csv":
         from pyarrow import csv as pacsv
 
-        return pacsv.read_csv(path_or_args)
+        return pacsv.read_csv(path_or_args, **kwargs)
     if kind == "json":
         from pyarrow import json as pajson
 
-        return pajson.read_json(path_or_args)
+        return pajson.read_json(path_or_args, **kwargs)
     raise ValueError(kind)
 
 
@@ -94,15 +95,24 @@ def from_arrow(table: pa.Table) -> Dataset:
 
 
 def read_parquet(paths, **kwargs) -> Dataset:
-    return Dataset([_read_shard.remote("parquet", p) for p in _expand_paths(paths)])
+    """kwargs forward to pyarrow.parquet.read_table (columns=, filters=, ...)."""
+    return Dataset(
+        [_read_shard.remote("parquet", p, kwargs) for p in _expand_paths(paths)]
+    )
 
 
 def read_csv(paths, **kwargs) -> Dataset:
-    return Dataset([_read_shard.remote("csv", p) for p in _expand_paths(paths)])
+    """kwargs forward to pyarrow.csv.read_csv (read_options=, ...)."""
+    return Dataset(
+        [_read_shard.remote("csv", p, kwargs) for p in _expand_paths(paths)]
+    )
 
 
 def read_json(paths, **kwargs) -> Dataset:
-    return Dataset([_read_shard.remote("json", p) for p in _expand_paths(paths)])
+    """kwargs forward to pyarrow.json.read_json."""
+    return Dataset(
+        [_read_shard.remote("json", p, kwargs) for p in _expand_paths(paths)]
+    )
 
 
 def _write_blocks(ds: Dataset, path: str, fmt: str) -> None:
