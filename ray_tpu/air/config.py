@@ -81,6 +81,9 @@ class RunConfig:
     storage_path: Optional[str] = None
     failure_config: FailureConfig = field(default_factory=FailureConfig)
     checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+    # trial stop criteria, e.g. {"training_iteration": 10} (reference:
+    # RunConfig(stop=...) / air.config)
+    stop: Optional[Dict[str, Any]] = None
     verbose: int = 1
     log_to_file: bool = False
 

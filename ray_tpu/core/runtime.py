@@ -86,6 +86,19 @@ class LocalRuntime:
         self._pgs: Dict[str, dict] = {}
         self._task_events: List[dict] = []  # timeline (ray timeline equivalent)
 
+        # Local mode shares one jax runtime across all worker THREADS (unlike
+        # cluster mode's worker processes). First-time backend init is not
+        # thread-safe with PJRT plugin registration (the axon plugin races:
+        # "Unable to initialize backend 'axon'... not in known backends"), so
+        # force it once, serially, before any worker thread can.
+        if not os.environ.get("RAY_TPU_SKIP_JAX_INIT"):
+            try:
+                import jax
+
+                jax.devices()
+            except Exception:
+                pass  # no usable backend; user code will surface its own error
+
         self._sched_cv = threading.Condition()
         self._stopped = False
         self._executor = ThreadPoolExecutor(

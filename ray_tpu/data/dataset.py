@@ -235,9 +235,9 @@ class Dataset:
 
     def filter(self, fn: Callable[[dict], bool]) -> "Dataset":
         def _filt(block):
-            return block_from_rows(
-                [r for r in BlockAccessor(block).iter_rows() if fn(r)]
-            )
+            # mask-based: preserves the schema even when every row drops
+            mask = [bool(fn(r)) for r in BlockAccessor(block).iter_rows()]
+            return block.filter(pa.array(mask, type=pa.bool_()))
 
         return self._with_stage(_MapStage(_filt, "filter"))
 
@@ -261,8 +261,13 @@ class Dataset:
             acc = BlockAccessor(block)
             nrows = acc.num_rows()
             if nrows == 0:
-                # never hand the user fn a schema-less empty batch
-                return block
+                if block.num_columns == 0:
+                    # schema-less empty: nothing the fn could act on
+                    return block
+                # empty but typed: run the fn so the OUTPUT schema is right
+                return block_from_batch(
+                    callable_fn(acc.to_batch(batch_format))
+                )
             size = batch_size or nrows
             outs = []
             for s in range(0, nrows, size):
@@ -315,7 +320,7 @@ class Dataset:
         )
 
     def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
-        n = max(len(self._input_refs), 1)
+        n = max(self.num_blocks(), 1)
 
         def part(block, n, idx, _seed=seed):
             # seed salted per block index: every map task draws an
@@ -352,7 +357,7 @@ class Dataset:
         runs as a prepare pass over the materialized input refs, so
         partition j holds exactly the j-th key range: concatenating the
         output blocks in order IS the global sort order."""
-        n = max(len(self._input_refs), 1)
+        n = max(self.num_blocks(), 1)
         order = "descending" if descending else "ascending"
 
         def prepare(refs, _key=key, _n=n):
@@ -429,9 +434,7 @@ class Dataset:
         return Dataset([ray_tpu.put(left)])
 
     def limit(self, n: int) -> "Dataset":
-        ds = Dataset(self._input_refs, list(self._stages))
-        ds._stages.append(_LimitStage(n))
-        return ds
+        return self._copy_with(list(self._stages) + [_LimitStage(n)])
 
     def split(self, n: int) -> List["Dataset"]:
         refs = self.repartition(n)._materialize_refs()

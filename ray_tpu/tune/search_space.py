@@ -1,0 +1,137 @@
+"""Search-space primitives.
+
+Reference: python/ray/tune/search/sample.py (Domain, Float, Integer,
+Categorical, grid_search) — the ``tune.uniform/loguniform/choice/...``
+surface.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+class Domain:
+    def sample(self, rng: np.random.Generator) -> Any:
+        raise NotImplementedError
+
+
+class Float(Domain):
+    def __init__(self, lower: float, upper: float, log: bool = False, q: Optional[float] = None):
+        if log and lower <= 0:
+            raise ValueError("loguniform requires lower > 0")
+        self.lower, self.upper, self.log, self.q = lower, upper, log, q
+
+    def sample(self, rng):
+        if self.log:
+            v = math.exp(rng.uniform(math.log(self.lower), math.log(self.upper)))
+        else:
+            v = rng.uniform(self.lower, self.upper)
+        if self.q:
+            v = round(v / self.q) * self.q
+        return float(v)
+
+
+class Integer(Domain):
+    def __init__(self, lower: int, upper: int, log: bool = False):
+        self.lower, self.upper, self.log = lower, upper, log
+
+    def sample(self, rng):
+        if self.log:
+            return int(
+                math.exp(rng.uniform(math.log(self.lower), math.log(self.upper)))
+            )
+        return int(rng.integers(self.lower, self.upper))
+
+
+class Categorical(Domain):
+    def __init__(self, categories: Sequence[Any]):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return self.categories[int(rng.integers(0, len(self.categories)))]
+
+
+class Function(Domain):
+    def __init__(self, fn: Callable[[], Any]):
+        self.fn = fn
+
+    def sample(self, rng):
+        return self.fn()
+
+
+class GridSearch:
+    """Marker resolved by the variant generator, not sampled."""
+
+    def __init__(self, values: Sequence[Any]):
+        self.values = list(values)
+
+
+# ------------------------------------------------------------ public surface
+
+def uniform(lower: float, upper: float) -> Float:
+    return Float(lower, upper)
+
+
+def quniform(lower: float, upper: float, q: float) -> Float:
+    return Float(lower, upper, q=q)
+
+
+def loguniform(lower: float, upper: float) -> Float:
+    return Float(lower, upper, log=True)
+
+
+def randint(lower: int, upper: int) -> Integer:
+    return Integer(lower, upper)
+
+
+def lograndint(lower: int, upper: int) -> Integer:
+    return Integer(lower, upper, log=True)
+
+
+def choice(categories: Sequence[Any]) -> Categorical:
+    return Categorical(categories)
+
+
+def sample_from(fn: Callable[[], Any]) -> Function:
+    return Function(fn)
+
+
+def grid_search(values: Sequence[Any]) -> Dict[str, Any]:
+    # reference shape: {"grid_search": [...]} dict marker
+    return {"grid_search": list(values)}
+
+
+def _is_grid(v: Any) -> bool:
+    return isinstance(v, dict) and set(v.keys()) == {"grid_search"}
+
+
+def resolve_variants(
+    param_space: Dict[str, Any], num_samples: int, seed: Optional[int] = None
+) -> List[Dict[str, Any]]:
+    """Grid cross-product × num_samples random draws (reference:
+    tune/search/basic_variant.py BasicVariantGenerator)."""
+    rng = np.random.default_rng(seed)
+    grid_keys = [k for k, v in param_space.items() if _is_grid(v)]
+    grids: List[Dict[str, Any]] = [{}]
+    for k in grid_keys:
+        grids = [
+            {**g, k: val} for g in grids for val in param_space[k]["grid_search"]
+        ]
+    variants = []
+    for _ in range(num_samples):
+        for g in grids:
+            cfg = {}
+            for k, v in param_space.items():
+                if k in g:
+                    cfg[k] = g[k]
+                elif isinstance(v, Domain):
+                    cfg[k] = v.sample(rng)
+                elif isinstance(v, dict) and not _is_grid(v):
+                    cfg[k] = resolve_variants(v, 1, seed=int(rng.integers(2**31)))[0]
+                else:
+                    cfg[k] = v
+            variants.append(cfg)
+    return variants

@@ -323,22 +323,33 @@ def test_random_shuffle_blocks_uncorrelated(data):
     assert other != shuffled
 
 
-def test_union_is_lazy_and_correct(data):
-    calls = []
+def test_union_is_lazy_and_correct(data, tmp_path):
+    import os
 
-    def tag(r):
+    sentinel = str(tmp_path / "executed")
+
+    def tag(r, _s=sentinel):
+        open(_s, "w").close()
         return {"v": r["v"] + 100}
 
     a = data.from_items([{"v": i} for i in range(3)]).map(tag)
     b = data.from_items([{"v": i} for i in range(3, 6)])
-    u = a.union(b)  # must not execute anything yet
+    u = a.union(b)
+    assert not os.path.exists(sentinel), "union() must not execute the pipeline"
     out = sorted(r["v"] for r in u.take_all())
-    assert out == [100, 101, 102, 3, 4, 5] or out == sorted([100, 101, 102, 3, 4, 5])
+    assert out == sorted([100, 101, 102, 3, 4, 5])
+    assert os.path.exists(sentinel)
     # stages still compose after a union
     doubled = u.map(lambda r: {"v": r["v"] * 2}).take_all()
     assert sorted(r["v"] for r in doubled) == sorted(
         v * 2 for v in [100, 101, 102, 3, 4, 5]
     )
+    # limit and shuffle on a union see the union's blocks (regression:
+    # both used to read len(_input_refs) == 0 / drop _parents)
+    assert len(u.limit(2).take_all()) == 2
+    assert u.num_blocks() == a.num_blocks() + b.num_blocks()
+    shuffled = u.random_shuffle(seed=1)
+    assert sorted(r["v"] for r in shuffled.take_all()) == out
 
 
 def test_read_parquet_kwargs_forwarded(data, tmp_path):

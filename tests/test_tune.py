@@ -1,0 +1,230 @@
+"""ray_tpu.tune tests (reference model: python/ray/tune/tests/ with mock
+trainables — SURVEY §4)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.air import Checkpoint, RunConfig
+
+
+@pytest.fixture
+def ray8(tmp_path):
+    ray_tpu.init(num_cpus=8)
+    yield str(tmp_path)
+    ray_tpu.shutdown()
+
+
+def test_search_space_sampling():
+    rng = np.random.default_rng(0)
+    assert 0.0 <= tune.uniform(0, 1).sample(rng) <= 1.0
+    v = tune.loguniform(1e-4, 1e-1).sample(rng)
+    assert 1e-4 <= v <= 1e-1
+    assert tune.randint(3, 7).sample(rng) in (3, 4, 5, 6)
+    assert tune.choice(["a", "b"]).sample(rng) in ("a", "b")
+    q = tune.quniform(0, 10, 0.5).sample(rng)
+    assert abs(q / 0.5 - round(q / 0.5)) < 1e-9
+
+
+def test_resolve_variants_grid_cross_product():
+    variants = tune.resolve_variants(
+        {"a": tune.grid_search([1, 2, 3]), "b": tune.grid_search(["x", "y"]),
+         "c": tune.uniform(0, 1), "d": "fixed"},
+        num_samples=2, seed=0,
+    )
+    assert len(variants) == 12  # 3 * 2 grid × 2 samples
+    assert {(v["a"], v["b"]) for v in variants} == {
+        (a, b) for a in (1, 2, 3) for b in ("x", "y")
+    }
+    assert all(v["d"] == "fixed" for v in variants)
+
+
+def test_tuner_basic_grid(ray8):
+    def trainable(config):
+        tune.report({"score": config["x"] * 2})
+
+    grid = tune.Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([1, 2, 5])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(name="grid", storage_path=ray8),
+    ).fit()
+    assert len(grid) == 3
+    best = grid.get_best_result()
+    assert best.metrics["score"] == 10
+    assert best.metrics["config"]["x"] == 5
+
+
+def test_tuner_min_mode_and_errors(ray8):
+    def trainable(config):
+        if config["x"] == 2:
+            raise RuntimeError("bad trial")
+        tune.report({"loss": float(config["x"])})
+
+    grid = tune.Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([1, 2, 3])},
+        tune_config=tune.TuneConfig(metric="loss", mode="min"),
+        run_config=RunConfig(name="minmode", storage_path=ray8),
+    ).fit()
+    assert len(grid.errors) == 1
+    assert grid.get_best_result().metrics["loss"] == 1.0
+
+
+def test_stop_criteria(ray8):
+    def trainable(config):
+        for i in range(100):
+            tune.report({"it": i})
+
+    grid = tune.Tuner(
+        trainable,
+        param_space={},
+        tune_config=tune.TuneConfig(metric="it", mode="max"),
+        run_config=RunConfig(name="stop", storage_path=ray8,
+                             stop={"training_iteration": 5}),
+    ).fit()
+    assert grid[0].metrics["training_iteration"] == 5
+
+
+def test_asha_early_stops_bad_trials(ray8):
+    """Bad trials stop at rungs; good ones reach max_t (reference:
+    async_hyperband tests)."""
+
+    def trainable(config):
+        for i in range(1, 17):
+            tune.report({"score": config["quality"] * i})
+
+    sched = tune.ASHAScheduler(max_t=16, grace_period=2, reduction_factor=2)
+    grid = tune.Tuner(
+        trainable,
+        param_space={"quality": tune.grid_search([1.0, 0.9, 0.2, 0.1])},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", scheduler=sched,
+            max_concurrent_trials=1,  # deterministic rung order
+        ),
+        run_config=RunConfig(name="asha", storage_path=ray8),
+    ).fit()
+    results = {r.metrics["config"]["quality"]: r.metrics["training_iteration"]
+               for r in grid}
+    assert results[1.0] == 16       # best survives to max_t
+    assert results[0.1] < 16        # worst early-stopped
+    assert not grid.errors
+
+
+def test_pbt_exploits_checkpoint(ray8):
+    """Bottom-quantile trial clones the top trial's checkpoint + mutated
+    config (reference: pbt.py exploit/explore)."""
+
+    def trainable(config):
+        ck = tune.get_checkpoint()
+        state = ck.to_dict() if ck else {"acc": 0.0}
+        acc = state["acc"]
+        for _ in range(12):
+            acc += config["lr"]
+            tune.report({"acc": acc, "lr": config["lr"]},
+                        checkpoint=Checkpoint.from_dict({"acc": acc}))
+
+    sched = tune.PopulationBasedTraining(
+        perturbation_interval=3,
+        hyperparam_mutations={"lr": [0.01, 0.1]},
+        quantile_fraction=0.5,
+        seed=0,
+    )
+    grid = tune.Tuner(
+        trainable,
+        param_space={"lr": tune.grid_search([0.01, 0.1])},
+        tune_config=tune.TuneConfig(metric="acc", mode="max", scheduler=sched),
+        run_config=RunConfig(name="pbt", storage_path=ray8),
+    ).fit()
+    assert not grid.errors
+    best = grid.get_best_result()
+    assert best.metrics["acc"] > 0.3  # exploitation pushed the slow trial up
+
+
+def test_tuner_restore_resumes_unfinished(ray8):
+    """Interrupt an experiment, restore it: finished trials keep results,
+    unfinished re-run from checkpoints (reference: Tuner.restore)."""
+    marker = os.path.join(ray8, "interrupted")
+
+    def trainable(config):
+        ck = tune.get_checkpoint()
+        start = ck.to_dict()["i"] + 1 if ck else 0
+        for i in range(start, 6):
+            if config["x"] == 2 and i == 3 and not os.path.exists(marker):
+                open(marker, "w").close()
+                raise RuntimeError("simulated interruption")
+            tune.report({"i": i, "x": config["x"]},
+                        checkpoint=Checkpoint.from_dict({"i": i}))
+
+    g1 = tune.Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([1, 2])},
+        tune_config=tune.TuneConfig(metric="i", mode="max"),
+        run_config=RunConfig(name="resume", storage_path=ray8),
+    ).fit()
+    exp_dir = os.path.dirname(g1[0].path)
+    assert len(g1.errors) == 1
+    g2 = tune.Tuner.restore(exp_dir, trainable).fit()
+    assert not g2.errors
+    for r in g2:
+        assert r.metrics["i"] == 5
+
+
+def test_trainer_as_trainable(ray8):
+    """A DataParallelTrainer runs under Tune with per-trial config
+    (reference: trainers are Tune trainables)."""
+    from ray_tpu import train
+    from ray_tpu.air import ScalingConfig
+    from ray_tpu.train import DataParallelTrainer
+
+    def loop(config):
+        train.report({"value": config["scale"] * 10.0})
+
+    trainer = DataParallelTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="inner", storage_path=ray8),
+    )
+    grid = tune.Tuner(
+        trainer,
+        param_space={"scale": tune.grid_search([1.0, 3.0])},
+        tune_config=tune.TuneConfig(metric="value", mode="max"),
+        run_config=RunConfig(name="outer", storage_path=ray8),
+    ).fit()
+    assert not grid.errors
+    assert grid.get_best_result().metrics["value"] == 30.0
+
+
+def test_asha_coarse_iteration_stride(ray8):
+    """Regression: ASHA rungs use >= with per-trial memory, so trainables
+    whose iteration counts skip milestone values still get pruned."""
+    sched = tune.ASHAScheduler(
+        metric="s", mode="max", max_t=100, grace_period=2, reduction_factor=2
+    )
+    from ray_tpu.tune.trial import Trial
+
+    t1 = Trial("a", {}, ray8)
+    t2 = Trial("b", {}, ray8)
+    # t1 (good) reports at it=5: crosses rungs 2 and 4 at once
+    assert sched.on_trial_result(t1, {"training_iteration": 5, "s": 10.0}, []) == "CONTINUE"
+    # t2 (bad) at it=5 must be cut at those same rungs
+    assert sched.on_trial_result(t2, {"training_iteration": 5, "s": 1.0}, []) == "STOP"
+    # a rung is never double-counted for one trial
+    assert sched.on_trial_result(t1, {"training_iteration": 6, "s": 10.0}, []) == "CONTINUE"
+    assert len(sched.rungs[2]) == 2
+
+
+def test_trial_state_roundtrip_preserves_history(ray8):
+    from ray_tpu.tune.trial import Trial
+
+    t = Trial("x", {"lr": 0.1}, ray8)
+    t.record({"m": 1.0})
+    t.record({"m": 2.0})
+    t.sched_state["last_perturb"] = 2
+    t.save_state()
+    back = Trial.load_state(t.dir, ray8)
+    assert len(back.results) == 2
+    assert back.sched_state["last_perturb"] == 2
