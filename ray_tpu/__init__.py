@@ -30,6 +30,7 @@ from ray_tpu.core.api import (
     kill,
     get_runtime_context,
     method,
+    get_actor,
     nodes,
     cluster_resources,
     available_resources,
@@ -57,6 +58,7 @@ __all__ = [
     "cancel",
     "kill",
     "method",
+    "get_actor",
     "nodes",
     "cluster_resources",
     "available_resources",

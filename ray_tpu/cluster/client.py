@@ -430,6 +430,20 @@ class ClusterClient:
     def available_resources(self) -> Dict[str, float]:
         return self.gcs.call("available_resources")
 
+    # ------------------------------------------------------------- kv store
+
+    def kv_put(self, key: str, value):
+        self.gcs.call("kv_put", {"key": key, "value": value})
+
+    def kv_get(self, key: str):
+        return self.gcs.call("kv_get", {"key": key})
+
+    def kv_del(self, key: str):
+        self.gcs.call("kv_del", {"key": key})
+
+    def kv_keys(self, prefix: str = ""):
+        return self.gcs.call("kv_keys", {"prefix": prefix})
+
     def nodes(self) -> List[dict]:
         raw = self.gcs.call("get_nodes")
         return [

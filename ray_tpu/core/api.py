@@ -215,10 +215,12 @@ class ActorHandle:
     """Reference to a live actor (reference: python/ray/actor.py ActorHandle).
     Picklable: other tasks can call through it."""
 
-    def __init__(self, actor_id: str, method_meta: Dict[str, int], creation_ref: ObjectRef):
+    def __init__(self, actor_id: str, method_meta: Dict[str, int], creation_ref: ObjectRef,
+                 name: str = ""):
         self._actor_id = actor_id
         self._method_meta = method_meta
         self._creation_ref = creation_ref
+        self._name = name
 
     def _invoke(self, method_name: str, args, kwargs, num_returns: int):
         rt = _get_runtime()
@@ -248,14 +250,15 @@ class ActorHandle:
 
     def __reduce__(self):
         return (_rebuild_actor_handle,
-                (self._actor_id, self._method_meta, self._creation_ref))
+                (self._actor_id, self._method_meta, self._creation_ref,
+                 self._name))
 
     def __repr__(self):
         return f"ActorHandle({self._actor_id})"
 
 
-def _rebuild_actor_handle(actor_id, method_meta, creation_ref):
-    return ActorHandle(actor_id, method_meta, creation_ref)
+def _rebuild_actor_handle(actor_id, method_meta, creation_ref, name=""):
+    return ActorHandle(actor_id, method_meta, creation_ref, name)
 
 
 class ActorClass:
@@ -297,7 +300,16 @@ class ActorClass:
         for mname, m in inspect.getmembers(self._cls, inspect.isfunction):
             if not mname.startswith("_"):
                 method_meta[mname] = int(getattr(m, "__num_returns__", 1))
-        return ActorHandle(actor_id, method_meta, refs[0])
+        handle = ActorHandle(actor_id, method_meta, refs[0],
+                             name=opts.get("name") or "")
+        if opts.get("name"):
+            # named-actor registry via the internal KV (reference:
+            # gcs_actor_manager named actors + ray.get_actor); last
+            # registration wins
+            import pickle as _pickle
+
+            rt.kv_put(f"named_actor:{opts['name']}", _pickle.dumps(handle))
+        return handle
 
     def __call__(self, *a, **kw):
         raise TypeError("Actor classes cannot be instantiated directly; use .remote().")
@@ -376,7 +388,15 @@ def cancel(ref: ObjectRef, *, force: bool = False):
 
 
 def kill(actor: ActorHandle, *, no_restart: bool = True):
-    _get_runtime().kill_actor(actor._actor_id, no_restart=no_restart)
+    rt = _get_runtime()
+    rt.kill_actor(actor._actor_id, no_restart=no_restart)
+    # drop the named-actor registration so get_actor stops returning a
+    # handle to a dead actor (reference: named actor entry removed on death)
+    if getattr(actor, "_name", ""):
+        try:
+            rt.kv_del(f"named_actor:{actor._name}")
+        except Exception:
+            pass
 
 
 # ------------------------------------------------------------------- metadata
@@ -401,6 +421,16 @@ class RuntimeContext:
 
 def get_runtime_context() -> RuntimeContext:
     return RuntimeContext(_get_runtime())
+
+
+def get_actor(name: str) -> ActorHandle:
+    """Look up a live named actor (reference: ray.get_actor)."""
+    import pickle as _pickle
+
+    data = _get_runtime().kv_get(f"named_actor:{name}")
+    if data is None:
+        raise ValueError(f"no actor registered with name {name!r}")
+    return _pickle.loads(data)
 
 
 def nodes() -> List[dict]:

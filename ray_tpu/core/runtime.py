@@ -85,6 +85,9 @@ class LocalRuntime:
         self._actors: Dict[str, _ActorState] = {}
         self._pgs: Dict[str, dict] = {}
         self._task_events: List[dict] = []  # timeline (ray timeline equivalent)
+        # internal KV (reference: GCS internal kv, _internal_kv_put — backs
+        # named actors, collective group rendezvous, serve state)
+        self._kv: Dict[str, bytes] = {}
 
         # Local mode shares one jax runtime across all worker THREADS (unlike
         # cluster mode's worker processes). First-time backend init is not
@@ -523,6 +526,24 @@ class LocalRuntime:
             st.dead = True
             st.death_cause = "ray_tpu.kill() called"
             st.cv.notify()
+
+    # ---------------------------------------------------------------- kv store
+
+    def kv_put(self, key: str, value):
+        with self._lock:
+            self._kv[key] = value
+
+    def kv_get(self, key: str):
+        with self._lock:
+            return self._kv.get(key)
+
+    def kv_del(self, key: str):
+        with self._lock:
+            self._kv.pop(key, None)
+
+    def kv_keys(self, prefix: str = ""):
+        with self._lock:
+            return [k for k in self._kv if k.startswith(prefix)]
 
     # ----------------------------------------------------------------- objects
 

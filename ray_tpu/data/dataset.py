@@ -264,10 +264,15 @@ class Dataset:
                 if block.num_columns == 0:
                     # schema-less empty: nothing the fn could act on
                     return block
-                # empty but typed: run the fn so the OUTPUT schema is right
-                return block_from_batch(
-                    callable_fn(acc.to_batch(batch_format))
-                )
+                # empty but typed: run the fn so the OUTPUT schema is right;
+                # fns that assume non-empty batches (e.g. batch["x"][0]) get
+                # the pre-transform empty block instead of crashing the task
+                try:
+                    return block_from_batch(
+                        callable_fn(acc.to_batch(batch_format))
+                    )
+                except Exception:
+                    return block
             size = batch_size or nrows
             outs = []
             for s in range(0, nrows, size):

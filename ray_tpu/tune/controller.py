@@ -177,11 +177,20 @@ class TuneController:
         elif decision == EXPLOIT:
             source, new_config = self.scheduler.choose_exploit(trial, self.trials)
             if source is not None and source.checkpoint_path:
+                # snapshot the source checkpoint into THIS trial's dir first:
+                # the source keeps running and its keep-only-latest retention
+                # may delete the original before the clone reads it
+                snap = os.path.join(trial.dir, "exploit_src")
+                shutil.rmtree(snap, ignore_errors=True)
+                try:
+                    shutil.copytree(source.checkpoint_path, snap)
+                except FileNotFoundError:
+                    return  # lost the race entirely; exploit again next round
                 rt.stopped_by_scheduler = True
                 self._teardown(rt)
                 trial.config = new_config
                 trial.sched_state["last_perturb"] = trial.iteration
-                self._launch(trial, start_checkpoint=source.checkpoint_path)
+                self._launch(trial, start_checkpoint=snap)
 
     def _handle_completion(self, rt: _RunningTrial):
         trial = rt.trial

@@ -108,30 +108,38 @@ def _is_grid(v: Any) -> bool:
     return isinstance(v, dict) and set(v.keys()) == {"grid_search"}
 
 
+def _expand_grids(space: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Cross-product over every grid_search, recursing into nested dicts.
+    Domain leaves are left unsampled."""
+    expanded: List[Dict[str, Any]] = [{}]
+    for k, v in space.items():
+        if _is_grid(v):
+            branches = v["grid_search"]
+        elif isinstance(v, dict):
+            branches = _expand_grids(v)  # nested grids cross-multiply too
+        else:
+            branches = [v]
+        expanded = [{**e, k: b} for e in expanded for b in branches]
+    return expanded
+
+
+def _sample_tree(space: Dict[str, Any], rng: np.random.Generator) -> Dict[str, Any]:
+    out = {}
+    for k, v in space.items():
+        if isinstance(v, Domain):
+            out[k] = v.sample(rng)
+        elif isinstance(v, dict):
+            out[k] = _sample_tree(v, rng)
+        else:
+            out[k] = v
+    return out
+
+
 def resolve_variants(
     param_space: Dict[str, Any], num_samples: int, seed: Optional[int] = None
 ) -> List[Dict[str, Any]]:
-    """Grid cross-product × num_samples random draws (reference:
-    tune/search/basic_variant.py BasicVariantGenerator)."""
+    """Grid cross-product (incl. nested grids) × num_samples random draws
+    (reference: tune/search/basic_variant.py BasicVariantGenerator)."""
     rng = np.random.default_rng(seed)
-    grid_keys = [k for k, v in param_space.items() if _is_grid(v)]
-    grids: List[Dict[str, Any]] = [{}]
-    for k in grid_keys:
-        grids = [
-            {**g, k: val} for g in grids for val in param_space[k]["grid_search"]
-        ]
-    variants = []
-    for _ in range(num_samples):
-        for g in grids:
-            cfg = {}
-            for k, v in param_space.items():
-                if k in g:
-                    cfg[k] = g[k]
-                elif isinstance(v, Domain):
-                    cfg[k] = v.sample(rng)
-                elif isinstance(v, dict) and not _is_grid(v):
-                    cfg[k] = resolve_variants(v, 1, seed=int(rng.integers(2**31)))[0]
-                else:
-                    cfg[k] = v
-            variants.append(cfg)
-    return variants
+    grids = _expand_grids(param_space)
+    return [_sample_tree(g, rng) for _ in range(num_samples) for g in grids]

@@ -137,6 +137,7 @@ class Tuner:
                     "num_trials": len(trials),
                     "metric": tc.metric,
                     "mode": tc.mode,
+                    "stop": self.run_config.stop,
                 }, f)
         trainable = self.trainable
 
@@ -172,10 +173,12 @@ class Tuner:
 
     @classmethod
     def restore(cls, path: str, trainable: Callable,
-                tune_config: Optional[TuneConfig] = None) -> "Tuner":
+                tune_config: Optional[TuneConfig] = None,
+                run_config: Optional[RunConfig] = None) -> "Tuner":
         """Resume an interrupted experiment: finished trials keep their
         results, unfinished ones restart from their latest checkpoints
-        (reference: Tuner.restore)."""
+        (reference: Tuner.restore). Stop criteria persist with the
+        experiment; pass run_config to override."""
         path = os.path.abspath(os.path.expanduser(path))
         state_f = os.path.join(path, "experiment_state.json")
         meta = {}
@@ -197,9 +200,12 @@ class Tuner:
         tc = tune_config or TuneConfig(
             metric=meta.get("metric"), mode=meta.get("mode", "max")
         )
+        if run_config is None:
+            run_config = RunConfig(stop=meta.get("stop"))
         return cls(
             trainable,
             tune_config=tc,
+            run_config=run_config,
             _restored_trials=trials,
             _experiment_dir=path,
         )

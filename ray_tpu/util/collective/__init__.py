@@ -1,0 +1,34 @@
+"""ray_tpu.util.collective — host-side tensor collectives.
+
+Reference: python/ray/util/collective/. In-mesh/device collectives are XLA
+ICI collectives compiled into SPMD programs (ray_tpu.parallel); this module
+is the host path (the reference's GLOO role).
+"""
+
+from ray_tpu.util.collective.collective import (
+    allgather,
+    allreduce,
+    barrier,
+    broadcast,
+    destroy_collective_group,
+    get_collective_group_size,
+    get_rank,
+    init_collective_group,
+    recv,
+    reducescatter,
+    send,
+)
+
+__all__ = [
+    "allgather",
+    "allreduce",
+    "barrier",
+    "broadcast",
+    "destroy_collective_group",
+    "get_collective_group_size",
+    "get_rank",
+    "init_collective_group",
+    "recv",
+    "reducescatter",
+    "send",
+]

@@ -1,0 +1,163 @@
+"""User-defined metrics: Counter/Gauge/Histogram with Prometheus text
+exposition.
+
+Reference: python/ray/util/metrics.py (Counter, Gauge, Histogram flowing
+through the per-node metrics agent to Prometheus; C++ registry in
+src/ray/stats/metric_defs.cc). Here metrics register in an in-process
+registry; ``export_prometheus()`` renders the standard text format and the
+cluster dashboard serves it (reference: dashboard/modules/metrics).
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_right
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_REGISTRY_LOCK = threading.Lock()
+_REGISTRY: Dict[str, "Metric"] = {}
+
+DEFAULT_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+def _key(tags: Optional[Dict[str, str]]) -> Tuple:
+    return tuple(sorted((tags or {}).items()))
+
+
+class Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Sequence[str] = ()):
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._default_tags: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        with _REGISTRY_LOCK:
+            _REGISTRY[name] = self
+
+    def set_default_tags(self, tags: Dict[str, str]):
+        self._default_tags = dict(tags)
+        return self
+
+    def _tags(self, tags: Optional[Dict[str, str]]) -> Dict[str, str]:
+        out = dict(self._default_tags)
+        out.update(tags or {})
+        return out
+
+    def _render_tags(self, key: Tuple) -> str:
+        if not key:
+            return ""
+        inner = ",".join(f'{k}="{v}"' for k, v in key)
+        return "{" + inner + "}"
+
+
+class Counter(Metric):
+    kind = "counter"
+
+    def __init__(self, name, description="", tag_keys=()):
+        super().__init__(name, description, tag_keys)
+        self._values: Dict[Tuple, float] = {}
+
+    def inc(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None):
+        if value < 0:
+            raise ValueError("counters only increase")
+        k = _key(self._tags(tags))
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + value
+
+    def _expose(self) -> List[str]:
+        with self._lock:
+            return [
+                f"{self.name}{self._render_tags(k)} {v}"
+                for k, v in sorted(self._values.items())
+            ]
+
+
+class Gauge(Metric):
+    kind = "gauge"
+
+    def __init__(self, name, description="", tag_keys=()):
+        super().__init__(name, description, tag_keys)
+        self._values: Dict[Tuple, float] = {}
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        with self._lock:
+            self._values[_key(self._tags(tags))] = float(value)
+
+    def inc(self, value: float = 1.0, tags=None):
+        k = _key(self._tags(tags))
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + value
+
+    def dec(self, value: float = 1.0, tags=None):
+        self.inc(-value, tags)
+
+    def _expose(self) -> List[str]:
+        with self._lock:
+            return [
+                f"{self.name}{self._render_tags(k)} {v}"
+                for k, v in sorted(self._values.items())
+            ]
+
+
+class Histogram(Metric):
+    kind = "histogram"
+
+    def __init__(self, name, description="", boundaries: Sequence[float] = DEFAULT_BUCKETS,
+                 tag_keys=()):
+        super().__init__(name, description, tag_keys)
+        self.boundaries = sorted(boundaries)
+        self._counts: Dict[Tuple, List[int]] = {}
+        self._sums: Dict[Tuple, float] = {}
+        self._totals: Dict[Tuple, int] = {}
+
+    def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
+        k = _key(self._tags(tags))
+        with self._lock:
+            counts = self._counts.setdefault(k, [0] * (len(self.boundaries) + 1))
+            counts[bisect_right(self.boundaries, value)] += 1
+            self._sums[k] = self._sums.get(k, 0.0) + value
+            self._totals[k] = self._totals.get(k, 0) + 1
+
+    def _expose(self) -> List[str]:
+        out = []
+        with self._lock:
+            for k, counts in sorted(self._counts.items()):
+                cum = 0
+                for b, c in zip(self.boundaries, counts):
+                    cum += c
+                    tags = dict(k)
+                    tags["le"] = repr(b)
+                    out.append(
+                        f"{self.name}_bucket{self._render_tags(_key(tags))} {cum}"
+                    )
+                tags = dict(k)
+                tags["le"] = "+Inf"
+                out.append(
+                    f"{self.name}_bucket{self._render_tags(_key(tags))} {self._totals[k]}"
+                )
+                out.append(f"{self.name}_sum{self._render_tags(k)} {self._sums[k]}")
+                out.append(f"{self.name}_count{self._render_tags(k)} {self._totals[k]}")
+        return out
+
+
+def export_prometheus() -> str:
+    """Render every registered metric in Prometheus text format."""
+    lines: List[str] = []
+    with _REGISTRY_LOCK:
+        metrics = list(_REGISTRY.values())
+    for m in metrics:
+        if m.description:
+            lines.append(f"# HELP {m.name} {m.description}")
+        lines.append(f"# TYPE {m.name} {m.kind}")
+        lines.extend(m._expose())
+    return "\n".join(lines) + "\n"
+
+
+def clear_registry():
+    with _REGISTRY_LOCK:
+        _REGISTRY.clear()

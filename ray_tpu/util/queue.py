@@ -1,0 +1,111 @@
+"""Distributed FIFO queue backed by an actor.
+
+Reference: python/ray/util/queue.py (Queue — actor-backed, blocking
+put/get with timeouts, qsize/empty/full).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, List, Optional
+
+import ray_tpu
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+@ray_tpu.remote(num_cpus=0)
+class _QueueActor:
+    def __init__(self, maxsize: int):
+        self._max = maxsize
+        self._q: deque = deque()
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+
+    def put(self, item, timeout: Optional[float] = None) -> bool:
+        with self._cv:
+            deadline = None if timeout is None else time.time() + timeout
+            while self._max > 0 and len(self._q) >= self._max:
+                left = None if deadline is None else deadline - time.time()
+                if left is not None and left <= 0:
+                    return False
+                self._cv.wait(timeout=min(left, 1.0) if left else 1.0)
+            self._q.append(item)
+            self._cv.notify_all()
+            return True
+
+    def get(self, timeout: Optional[float] = None):
+        with self._cv:
+            deadline = None if timeout is None else time.time() + timeout
+            while not self._q:
+                left = None if deadline is None else deadline - time.time()
+                if left is not None and left <= 0:
+                    return ("__empty__",)
+                self._cv.wait(timeout=min(left, 1.0) if left else 1.0)
+            item = self._q.popleft()
+            self._cv.notify_all()
+            return ("__item__", item)
+
+    def qsize(self) -> int:
+        with self._lock:
+            return len(self._q)
+
+    def drain(self, max_items: int) -> List[Any]:
+        with self._cv:
+            out = []
+            while self._q and len(out) < max_items:
+                out.append(self._q.popleft())
+            if out:
+                self._cv.notify_all()
+            return out
+
+
+class Queue:
+    def __init__(self, maxsize: int = 0, actor_options: Optional[dict] = None):
+        opts = dict(actor_options or {})
+        opts.setdefault("num_cpus", 0)
+        opts.setdefault("max_concurrency", 16)
+        self.maxsize = maxsize
+        self._actor = _QueueActor.options(**opts).remote(maxsize)
+
+    def put(self, item, block: bool = True, timeout: Optional[float] = None):
+        ok = ray_tpu.get(self._actor.put.remote(
+            item, timeout if block else 0.0))
+        if not ok:
+            raise Full("queue full")
+
+    def put_nowait(self, item):
+        self.put(item, block=False)
+
+    def get(self, block: bool = True, timeout: Optional[float] = None):
+        res = ray_tpu.get(self._actor.get.remote(
+            timeout if block else 0.0))
+        if res[0] == "__empty__":
+            raise Empty("queue empty")
+        return res[1]
+
+    def get_nowait(self):
+        return self.get(block=False)
+
+    def get_nowait_batch(self, max_items: int) -> List[Any]:
+        return ray_tpu.get(self._actor.drain.remote(max_items))
+
+    def qsize(self) -> int:
+        return ray_tpu.get(self._actor.qsize.remote())
+
+    def empty(self) -> bool:
+        return self.qsize() == 0
+
+    def full(self) -> bool:
+        return self.maxsize > 0 and self.qsize() >= self.maxsize
+
+    def shutdown(self):
+        ray_tpu.kill(self._actor)

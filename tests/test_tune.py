@@ -228,3 +228,28 @@ def test_trial_state_roundtrip_preserves_history(ray8):
     back = Trial.load_state(t.dir, ray8)
     assert len(back.results) == 2
     assert back.sched_state["last_perturb"] == 2
+
+
+def test_nested_grid_search_expands(ray8):
+    """Regression: nested grid_search participates in the cross product."""
+    variants = tune.resolve_variants(
+        {"opt": {"lr": tune.grid_search([0.1, 0.01])},
+         "b": tune.grid_search([1, 2])},
+        num_samples=1,
+    )
+    assert len(variants) == 4
+    assert {(v["opt"]["lr"], v["b"]) for v in variants} == {
+        (lr, b) for lr in (0.1, 0.01) for b in (1, 2)
+    }
+
+
+def test_restore_preserves_stop_criteria(ray8, tmp_path):
+    """Regression: Tuner.restore keeps the experiment's stop dict."""
+    meta_dir = str(tmp_path / "exp")
+    os.makedirs(meta_dir)
+    import json
+
+    with open(os.path.join(meta_dir, "experiment_state.json"), "w") as f:
+        json.dump({"metric": "m", "mode": "max", "stop": {"training_iteration": 7}}, f)
+    t = tune.Tuner.restore(meta_dir, lambda c: None)
+    assert t.run_config.stop == {"training_iteration": 7}

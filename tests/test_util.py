@@ -1,0 +1,167 @@
+"""Tests for ray_tpu.util: collective, queue, multiprocessing Pool, metrics,
+named actors (reference: python/ray/tests/test_collective*, test_queue,
+test_multiprocessing, test_metrics)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def ray8():
+    ray_tpu.init(num_cpus=8)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_named_actor_lookup(ray8):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+    Counter.options(name="global_counter").remote()
+    h = ray_tpu.get_actor("global_counter")
+    assert ray_tpu.get(h.incr.remote()) == 1
+    h2 = ray_tpu.get_actor("global_counter")
+    assert ray_tpu.get(h2.incr.remote()) == 2
+    with pytest.raises(ValueError):
+        ray_tpu.get_actor("nope")
+
+
+def test_collective_allreduce_allgather(ray8):
+    from ray_tpu.util import collective  # noqa: F401
+
+    @ray_tpu.remote
+    def worker(rank, world):
+        from ray_tpu.util import collective as col
+
+        col.init_collective_group(world, rank, group_name="g1")
+        out = col.allreduce(np.full(4, rank + 1.0), group_name="g1")
+        gathered = col.allgather(np.array([rank]), group_name="g1")
+        rs = col.reducescatter(np.arange(world * 2.0), group_name="g1")
+        bc = col.broadcast(np.array([rank * 10.0]), src_rank=2, group_name="g1")
+        col.barrier(group_name="g1")
+        return out, gathered, rs, bc
+
+    world = 4
+    results = ray_tpu.get([worker.remote(r, world) for r in range(world)])
+    expected_sum = sum(range(1, world + 1))
+    for rank, (out, gathered, rs, bc) in enumerate(results):
+        np.testing.assert_array_equal(out, np.full(4, float(expected_sum)))
+        np.testing.assert_array_equal(
+            np.concatenate(gathered), np.arange(world)
+        )
+        # reducescatter of sum(identical arange) = world * arange, rank slice
+        np.testing.assert_array_equal(
+            rs, (world * np.arange(world * 2.0))[rank * 2:(rank + 1) * 2]
+        )
+        np.testing.assert_array_equal(bc, np.array([20.0]))
+
+
+def test_collective_send_recv(ray8):
+    @ray_tpu.remote
+    def worker(rank):
+        from ray_tpu.util import collective as col
+
+        col.init_collective_group(2, rank, group_name="p2p")
+        if rank == 0:
+            col.send(np.array([1.0, 2.0]), dst_rank=1, group_name="p2p")
+            return None
+        return col.recv(src_rank=0, group_name="p2p")
+
+    _, got = ray_tpu.get([worker.remote(0), worker.remote(1)])
+    np.testing.assert_array_equal(got, np.array([1.0, 2.0]))
+
+
+def test_queue_fifo_and_timeout(ray8):
+    from ray_tpu.util.queue import Empty, Queue
+
+    q = Queue(maxsize=3)
+    q.put(1)
+    q.put(2)
+    assert q.qsize() == 2
+    assert q.get() == 1
+    assert q.get() == 2
+    with pytest.raises(Empty):
+        q.get(timeout=0.1)
+    q.shutdown()
+
+
+def test_queue_producer_consumer(ray8):
+    from ray_tpu.util.queue import Queue
+
+    q = Queue()
+
+    @ray_tpu.remote
+    def producer(q, n):
+        for i in range(n):
+            q.put(i)
+        return True
+
+    @ray_tpu.remote
+    def consumer(q, n):
+        return [q.get(timeout=10.0) for _ in range(n)]
+
+    p = producer.remote(q, 10)
+    c = consumer.remote(q, 10)
+    assert ray_tpu.get(c) == list(range(10))
+    ray_tpu.get(p)
+    q.shutdown()
+
+
+def test_pool_map_and_async(ray8):
+    from ray_tpu.util.multiprocessing import Pool
+
+    with Pool() as pool:
+        assert pool.map(lambda x: x * x, range(8)) == [x * x for x in range(8)]
+        ar = pool.apply_async(lambda a, b: a + b, (2, 3))
+        assert ar.get(timeout=10.0) == 5
+        assert pool.starmap(lambda a, b: a * b, [(1, 2), (3, 4)]) == [2, 12]
+        assert sorted(pool.imap_unordered(lambda x: -x, range(4))) == [-3, -2, -1, 0]
+
+
+def test_metrics_prometheus_exposition(ray8):
+    from ray_tpu.util import metrics
+
+    metrics.clear_registry()
+    c = metrics.Counter("req_total", "total requests", ("route",))
+    c.inc(1, {"route": "/a"})
+    c.inc(2, {"route": "/a"})
+    c.inc(5, {"route": "/b"})
+    g = metrics.Gauge("inflight", "in-flight requests")
+    g.set(7)
+    h = metrics.Histogram("latency_s", "request latency", boundaries=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = metrics.export_prometheus()
+    assert 'req_total{route="/a"} 3.0' in text
+    assert 'req_total{route="/b"} 5.0' in text
+    assert "# TYPE req_total counter" in text
+    assert "inflight 7.0" in text
+    assert 'latency_s_bucket{le="0.1"} 1' in text
+    assert 'latency_s_bucket{le="+Inf"} 3' in text
+    assert "latency_s_count 3" in text
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+def test_killed_named_actor_unregistered(ray8):
+    """Regression: kill() removes the named-actor KV entry."""
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return "pong"
+
+    h = A.options(name="doomed").remote()
+    assert ray_tpu.get(ray_tpu.get_actor("doomed").ping.remote()) == "pong"
+    ray_tpu.kill(h)
+    with pytest.raises(ValueError):
+        ray_tpu.get_actor("doomed")
