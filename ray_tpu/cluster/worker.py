@@ -101,6 +101,12 @@ def main():  # pragma: no cover - runs as a subprocess
     tasks: "queue.Queue[dict]" = queue.Queue()
     client.subscribe("run_task", tasks.put)
     client.on_close = lambda: os._exit(0)  # daemon gone -> exit
+    # Install the cluster runtime NOW (env RAY_TPU_GCS_ADDR -> ClusterClient)
+    # rather than relying on lazy auto-init: threaded-actor methods run on
+    # pool threads, where auto-init is forbidden.
+    import ray_tpu
+
+    ray_tpu.init(ignore_reinit_error=True)
     client.call("worker_ready", {"worker_id": worker_id, "pid": os.getpid()})
     # Threaded-actor pool (reference: max_concurrency>1): methods of an actor
     # created with max_concurrency>1 may overlap/block on each other.

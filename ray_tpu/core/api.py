@@ -84,6 +84,15 @@ def is_initialized() -> bool:
 
 def _get_runtime():
     if _runtime is None:
+        # Auto-init only from the main thread (reference: implicit ray.init
+        # on first use). Background/daemon threads must never resurrect a
+        # runtime after shutdown — a stray actor-side thread doing so leaks
+        # a whole new runtime between tests/apps.
+        if threading.current_thread() is not threading.main_thread():
+            raise RuntimeError(
+                "ray_tpu is not initialized (and auto-init is main-thread "
+                "only); call ray_tpu.init() first"
+            )
         init()
     return _runtime
 
@@ -392,9 +401,17 @@ def kill(actor: ActorHandle, *, no_restart: bool = True):
     rt.kill_actor(actor._actor_id, no_restart=no_restart)
     # drop the named-actor registration so get_actor stops returning a
     # handle to a dead actor (reference: named actor entry removed on death)
+    # — but only if the registry still points at THIS actor (a newer actor
+    # may have reused the name; last-registration-wins must survive the kill
+    # of its predecessor)
     if getattr(actor, "_name", ""):
+        import pickle as _pickle
+
+        key = f"named_actor:{actor._name}"
         try:
-            rt.kv_del(f"named_actor:{actor._name}")
+            data = rt.kv_get(key)
+            if data is not None and _pickle.loads(data)._actor_id == actor._actor_id:
+                rt.kv_del(key)
         except Exception:
             pass
 

@@ -265,13 +265,13 @@ class Dataset:
                     # schema-less empty: nothing the fn could act on
                     return block
                 # empty but typed: run the fn so the OUTPUT schema is right;
-                # fns that assume non-empty batches (e.g. batch["x"][0]) get
-                # the pre-transform empty block instead of crashing the task
+                # only empty-batch-shaped failures (indexing/reducing zero
+                # rows) fall back to the input block — real fn bugs propagate
                 try:
                     return block_from_batch(
                         callable_fn(acc.to_batch(batch_format))
                     )
-                except Exception:
+                except (IndexError, ValueError, ZeroDivisionError, StopIteration):
                     return block
             size = batch_size or nrows
             outs = []

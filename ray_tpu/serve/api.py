@@ -1,0 +1,126 @@
+"""serve public API: run/delete/status/shutdown.
+
+Reference: python/ray/serve/api.py (serve.run deploys an Application through
+the controller and returns the ingress handle; serve.start launches the
+proxy).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+import ray_tpu
+from ray_tpu.serve.controller import ServeController
+from ray_tpu.serve.deployment import Application, Deployment
+from ray_tpu.serve.handle import DeploymentHandle
+from ray_tpu.serve.proxy import HTTPProxy
+
+_CONTROLLER_NAME = "serve:controller"
+_PROXY_NAME = "serve:http_proxy"
+
+
+def _get_controller(create: bool = False):
+    try:
+        return ray_tpu.get_actor(_CONTROLLER_NAME)
+    except ValueError:
+        if not create:
+            raise RuntimeError("serve is not running (call serve.run first)")
+        return ServeController.options(
+            name=_CONTROLLER_NAME, num_cpus=0, max_concurrency=16
+        ).remote()
+
+
+def start(http_port: int = 0):
+    """Ensure controller + HTTP proxy exist (reference: serve.start)."""
+    ctrl = _get_controller(create=True)
+    try:
+        proxy = ray_tpu.get_actor(_PROXY_NAME)
+    except ValueError:
+        proxy = HTTPProxy.options(
+            name=_PROXY_NAME, num_cpus=0, max_concurrency=32
+        ).remote(http_port)
+    return ctrl, proxy
+
+
+def run(app: Application, *, name: str = "default",
+        route_prefix: Optional[str] = "/", _blocking: bool = False,
+        http_port: int = 0) -> DeploymentHandle:
+    """Deploy an application; returns the ingress DeploymentHandle."""
+    if isinstance(app, Deployment):
+        app = app.bind()
+    ctrl = _get_controller(create=True)
+    # topological order: dependencies first; bound-Application args become
+    # handles (model composition, reference: deployment graph build)
+    nodes = app._walk({})
+    specs = []
+    for node_name, node in nodes.items():
+        d = node.deployment
+
+        def to_handle(v):
+            if isinstance(v, Application):
+                return DeploymentHandle(v.deployment.name, name)
+            return v
+
+        specs.append({
+            "name": d.name,
+            "func_or_class": d.func_or_class,
+            "init_args": tuple(to_handle(a) for a in node.args),
+            "init_kwargs": {k: to_handle(v) for k, v in node.kwargs.items()},
+            "num_replicas": d.num_replicas,
+            "ray_actor_options": d.ray_actor_options,
+            "max_ongoing_requests": d.max_ongoing_requests,
+            "autoscaling_config": d.autoscaling_config,
+            "user_config": d.user_config,
+            "version": d.version,
+        })
+    ray_tpu.get(ctrl.deploy_application.remote(
+        name, specs, app.deployment.name))
+    ingress = DeploymentHandle(app.deployment.name, name)
+    if route_prefix is not None:
+        _, proxy = start(http_port)
+        ray_tpu.get(proxy.set_route.remote(route_prefix, ingress))
+    return ingress
+
+
+def get_app_handle(name: str = "default") -> DeploymentHandle:
+    """Handle to a running application's ingress (reference:
+    serve.get_app_handle)."""
+    ctrl = _get_controller()
+    ingress = ray_tpu.get(ctrl.get_ingress.remote(name))
+    if ingress is None:
+        raise KeyError(f"no application {name!r}")
+    return DeploymentHandle(ingress, name)
+
+
+def get_deployment_handle(deployment_name: str, app_name: str = "default") -> DeploymentHandle:
+    return DeploymentHandle(deployment_name, app_name)
+
+
+def status() -> Dict[str, Any]:
+    ctrl = _get_controller()
+    return ray_tpu.get(ctrl.status.remote())
+
+
+def delete(name: str):
+    ctrl = _get_controller()
+    ray_tpu.get(ctrl.delete_application.remote(name))
+
+
+def http_port() -> int:
+    proxy = ray_tpu.get_actor(_PROXY_NAME)
+    return ray_tpu.get(proxy.get_port.remote())
+
+
+def shutdown():
+    try:
+        ctrl = ray_tpu.get_actor(_CONTROLLER_NAME)
+        ray_tpu.get(ctrl.shutdown.remote(), timeout=10.0)
+        ray_tpu.kill(ctrl)
+    except Exception:
+        pass
+    try:
+        proxy = ray_tpu.get_actor(_PROXY_NAME)
+        ray_tpu.kill(proxy)
+    except Exception:
+        pass

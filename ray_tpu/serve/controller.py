@@ -1,0 +1,219 @@
+"""ServeController: reconciles deployment state to replica actors.
+
+Reference: python/ray/serve/_private/controller.py (ServeController) +
+deployment_state.py (target vs running replica reconciliation) +
+autoscaling_policy.py (ongoing-requests-per-replica policy).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.serve.replica import ServeReplica
+
+
+class _DeploymentState:
+    def __init__(self, spec: Dict[str, Any]):
+        self.spec = spec
+        self.replicas: List = []
+        self.version = 0
+        self.target = spec["num_replicas"]
+        self.last_scale_time = 0.0
+        self.scale_signal_since: Optional[float] = None
+        self.scale_signal_dir = 0
+        self.next_replica_id = 0
+        # replica_id -> (ongoing, timestamp), pushed by replicas
+        self.stats: Dict[int, tuple] = {}
+
+
+@ray_tpu.remote(num_cpus=0)
+class ServeController:
+    def __init__(self):
+        self._lock = threading.RLock()
+        # app -> deployment name -> state
+        self._apps: Dict[str, Dict[str, _DeploymentState]] = {}
+        self._ingress: Dict[str, str] = {}
+        self._stop = False
+        self._loop = threading.Thread(target=self._control_loop, daemon=True)
+        self._loop.start()
+
+    # ------------------------------------------------------------ deploy API
+    def deploy_application(self, app_name: str, specs: List[Dict[str, Any]],
+                           ingress: Optional[str] = None):
+        with self._lock:
+            if ingress is not None:
+                self._ingress[app_name] = ingress
+            app = self._apps.setdefault(app_name, {})
+            for spec in specs:
+                name = spec["name"]
+                old = app.get(name)
+                if old is not None:
+                    # in-place update: new code/config, replace replicas
+                    for r in old.replicas:
+                        self._kill(r)
+                    old.spec = spec
+                    old.replicas = []
+                    old.target = spec["num_replicas"]
+                    old.version += 1
+                else:
+                    app[name] = _DeploymentState(spec)
+            self._reconcile_locked()
+        return True
+
+    def delete_application(self, app_name: str):
+        with self._lock:
+            app = self._apps.pop(app_name, {})
+            for st in app.values():
+                for r in st.replicas:
+                    self._kill(r)
+        return True
+
+    def shutdown(self):
+        with self._lock:
+            self._stop = True
+            for app in self._apps.values():
+                for st in app.values():
+                    for r in st.replicas:
+                        self._kill(r)
+            self._apps.clear()
+        return True
+
+    # -------------------------------------------------------------- queries
+    def get_replicas(self, app_name: str, deployment_name: str):
+        with self._lock:
+            st = self._state(app_name, deployment_name)
+            return {"replicas": list(st.replicas), "version": st.version}
+
+    def get_ingress(self, app_name: str) -> Optional[str]:
+        with self._lock:
+            return self._ingress.get(app_name)
+
+    def get_replica_version(self, app_name: str, deployment_name: str) -> int:
+        with self._lock:
+            st = self._apps.get(app_name, {}).get(deployment_name)
+            return st.version if st else -1
+
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                app: {
+                    name: {
+                        "num_replicas": len(st.replicas),
+                        "target": st.target,
+                        "version": st.version,
+                    }
+                    for name, st in deps.items()
+                }
+                for app, deps in self._apps.items()
+            }
+
+    def _state(self, app_name, deployment_name) -> _DeploymentState:
+        st = self._apps.get(app_name, {}).get(deployment_name)
+        if st is None:
+            raise KeyError(f"unknown deployment {app_name}/{deployment_name}")
+        return st
+
+    # ----------------------------------------------------------- reconcile
+    def _kill(self, replica):
+        try:
+            ray_tpu.kill(replica)
+        except Exception:
+            pass
+
+    def _reconcile_locked(self):
+        for app_name, deps in self._apps.items():
+            for name, st in deps.items():
+                delta = st.target - len(st.replicas)
+                if delta > 0:
+                    spec = st.spec
+                    opts = dict(spec["ray_actor_options"])
+                    opts.setdefault("num_cpus", 0.1)
+                    opts["max_concurrency"] = max(
+                        int(spec["max_ongoing_requests"]), 2
+                    )
+                    for _ in range(delta):
+                        rid = st.next_replica_id
+                        st.next_replica_id += 1
+                        st.replicas.append(
+                            ServeReplica.options(**opts).remote(
+                                spec["func_or_class"],
+                                spec["init_args"],
+                                spec["init_kwargs"],
+                                spec.get("user_config"),
+                                identity=(app_name, name, rid),
+                            )
+                        )
+                    st.version += 1
+                elif delta < 0:
+                    for r in st.replicas[st.target:]:
+                        self._kill(r)
+                    st.replicas = st.replicas[: st.target]
+                    st.version += 1
+
+    # --------------------------------------------------------- autoscaling
+    def _control_loop(self):
+        while not self._stop:
+            time.sleep(0.25)
+            try:
+                self._autoscale_tick()
+            except Exception:
+                pass
+
+    def record_stats(self, identity, ongoing: int):
+        app_name, dep_name, rid = identity
+        with self._lock:
+            st = self._apps.get(app_name, {}).get(dep_name)
+            if st is not None:
+                st.stats[rid] = (ongoing, time.time())
+        return True
+
+    def _autoscale_tick(self):
+        with self._lock:
+            states = [
+                st
+                for deps in self._apps.values()
+                for st in deps.values()
+                if st.spec.get("autoscaling_config") is not None
+            ]
+        for st in states:
+            cfg = st.spec["autoscaling_config"]
+            now = time.time()
+            with self._lock:
+                if not st.replicas:
+                    continue
+                # drop records from replicas that stopped reporting (killed)
+                st.stats = {
+                    rid: rec for rid, rec in st.stats.items()
+                    if now - rec[1] < 10.0
+                }
+                fresh = [
+                    ongoing for ongoing, ts in st.stats.values()
+                    if now - ts < 2.0
+                ]
+            if not fresh:
+                continue
+            avg_ongoing = sum(fresh) / len(fresh)
+            if avg_ongoing > cfg.target_ongoing_requests and st.target < cfg.max_replicas:
+                direction, delay = 1, cfg.upscale_delay_s
+            elif (
+                avg_ongoing < cfg.target_ongoing_requests * 0.5
+                and st.target > cfg.min_replicas
+            ):
+                direction, delay = -1, cfg.downscale_delay_s
+            else:
+                direction, delay = 0, 0.0
+            with self._lock:
+                if direction == 0 or direction != st.scale_signal_dir:
+                    st.scale_signal_dir = direction
+                    st.scale_signal_since = now if direction else None
+                    continue
+                if now - (st.scale_signal_since or now) >= delay:
+                    st.target = min(
+                        max(st.target + direction, cfg.min_replicas),
+                        cfg.max_replicas,
+                    )
+                    st.scale_signal_since = now
+                    self._reconcile_locked()

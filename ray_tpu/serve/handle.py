@@ -1,0 +1,112 @@
+"""DeploymentHandle: the client-side router.
+
+Reference: python/ray/serve/handle.py (DeploymentHandle) +
+serve/_private/replica_scheduler/pow_2_scheduler.py — requests go to the
+less-loaded of two randomly chosen replicas, load measured by THIS
+handle's in-flight count per replica (locally observed, no extra RPC).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+
+class DeploymentResponse:
+    """Future-like wrapper over the underlying ObjectRef (reference:
+    serve.handle.DeploymentResponse)."""
+
+    def __init__(self, ref):
+        self._ref = ref
+
+    def result(self, timeout: Optional[float] = None):
+        return ray_tpu.get(self._ref, timeout=timeout)
+
+    @property
+    def ref(self):
+        return self._ref
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, app_name: str = "default",
+                 method_name: Optional[str] = None):
+        self.deployment_name = deployment_name
+        self.app_name = app_name
+        self._method_name = method_name
+        self._lock = threading.Lock()
+        self._replicas: List = []
+        self._replica_version = -1
+        self._inflight: Dict[int, List] = {}  # replica idx -> pending refs
+        self._rng = random.Random()
+
+    # picklable: handles travel into other replicas for composition
+    def __reduce__(self):
+        return (DeploymentHandle,
+                (self.deployment_name, self.app_name, self._method_name))
+
+    def options(self, method_name: Optional[str] = None) -> "DeploymentHandle":
+        h = DeploymentHandle(self.deployment_name, self.app_name, method_name)
+        return h
+
+    # --------------------------------------------------------------- routing
+    def _refresh_replicas(self):
+        from ray_tpu.serve.api import _get_controller
+
+        ctrl = _get_controller()
+        info = ray_tpu.get(
+            ctrl.get_replicas.remote(self.app_name, self.deployment_name)
+        )
+        with self._lock:
+            self._replicas = info["replicas"]
+            self._replica_version = info["version"]
+            self._inflight = {i: [] for i in range(len(self._replicas))}
+
+    def _maybe_refresh(self):
+        from ray_tpu.serve.api import _get_controller
+
+        ctrl = _get_controller()
+        v = ray_tpu.get(
+            ctrl.get_replica_version.remote(self.app_name, self.deployment_name)
+        )
+        if v != self._replica_version:
+            self._refresh_replicas()
+
+    def _pick_replica(self) -> int:
+        """Power of two choices on locally-observed in-flight counts
+        (reference: pow_2_scheduler.py)."""
+        with self._lock:
+            n = len(self._replicas)
+            if n == 0:
+                raise RuntimeError(
+                    f"deployment {self.deployment_name} has no replicas"
+                )
+            # prune completed refs
+            for i, refs in self._inflight.items():
+                if refs:
+                    done, pending = ray_tpu.wait(
+                        refs, num_returns=len(refs), timeout=0
+                    )
+                    self._inflight[i] = list(pending)
+            if n == 1:
+                return 0
+            a, b = self._rng.sample(range(n), 2)
+            return a if len(self._inflight[a]) <= len(self._inflight[b]) else b
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        self._maybe_refresh()
+        idx = self._pick_replica()
+        with self._lock:
+            replica = self._replicas[idx]
+        ref = replica.handle_request.remote(self._method_name, args, kwargs)
+        with self._lock:
+            self._inflight.setdefault(idx, []).append(ref)
+        return DeploymentResponse(ref)
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        # handle.method.remote(...) sugar (reference: handle.method_name)
+        return self.options(method_name=name)

@@ -1,0 +1,92 @@
+"""Replica actor: hosts one copy of a deployment.
+
+Reference: python/ray/serve/_private/replica.py (UserCallableWrapper /
+RayServeReplica — counts ongoing requests, calls user code, supports
+function and class deployments, reconfigure via user_config).
+"""
+
+from __future__ import annotations
+
+import inspect
+import threading
+from typing import Any, Dict, Optional
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class ServeReplica:
+    def __init__(self, func_or_class, init_args, init_kwargs,
+                 user_config: Optional[Dict] = None,
+                 identity: Optional[tuple] = None,
+                 metrics_period_s: float = 0.2):
+        self._lock = threading.Lock()
+        self._ongoing = 0
+        self._total = 0
+        if inspect.isclass(func_or_class):
+            self._callable = func_or_class(*init_args, **init_kwargs)
+            self._is_function = False
+        else:
+            self._callable = func_or_class
+            self._is_function = True
+        if user_config is not None and hasattr(self._callable, "reconfigure"):
+            self._callable.reconfigure(user_config)
+        # Autoscaling metrics are PUSHED from a side thread (reference:
+        # replica.py pushes to the controller): a poll through the mailbox
+        # would queue behind pending requests and always observe drained
+        # state.
+        if identity is not None:
+            self._identity = identity
+            threading.Thread(
+                target=self._push_metrics_loop, args=(metrics_period_s,),
+                daemon=True,
+            ).start()
+
+    def _push_metrics_loop(self, period: float):
+        import time as _time
+
+        import ray_tpu as _rt
+        from ray_tpu.core import api as _api
+
+        rt0 = _api._runtime  # the runtime this replica belongs to
+        ctrl = None
+        while True:
+            _time.sleep(period)
+            if _api._runtime is not rt0:
+                return  # runtime shut down or replaced; this replica is dead
+            try:
+                if ctrl is None:
+                    ctrl = _rt.get_actor("serve:controller")
+                with self._lock:
+                    ongoing = self._ongoing
+                ctrl.record_stats.remote(list(self._identity), ongoing)
+            except Exception:
+                ctrl = None  # controller gone/respawned; re-resolve
+
+    def handle_request(self, method_name: str, args, kwargs):
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            if self._is_function:
+                target = self._callable
+            else:
+                target = getattr(self._callable, method_name or "__call__")
+            return target(*args, **kwargs)
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
+    def reconfigure(self, user_config: Dict):
+        if hasattr(self._callable, "reconfigure"):
+            self._callable.reconfigure(user_config)
+        return True
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"ongoing": self._ongoing, "total": self._total}
+
+    def health_check(self) -> bool:
+        if hasattr(self._callable, "check_health"):
+            self._callable.check_health()
+        return True

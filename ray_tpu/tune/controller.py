@@ -184,8 +184,10 @@ class TuneController:
                 shutil.rmtree(snap, ignore_errors=True)
                 try:
                     shutil.copytree(source.checkpoint_path, snap)
-                except FileNotFoundError:
-                    return  # lost the race entirely; exploit again next round
+                except (FileNotFoundError, shutil.Error, OSError):
+                    # mid-copy deletion by the source's retention; try next round
+                    shutil.rmtree(snap, ignore_errors=True)
+                    return
                 rt.stopped_by_scheduler = True
                 self._teardown(rt)
                 trial.config = new_config

@@ -59,21 +59,25 @@ class _GroupCoordinator:
     def collect(self, op_id: str, rank: int, payload, compute: str,
                 op: str = "sum"):
         """Generic barrier-collect: every rank contributes, one computation
-        runs, every rank receives. compute: reduce | gather | reducescatter."""
+        runs, every rank receives. compute: reduce | gather | reducescatter
+        | bcast (op carries the src rank; only src ships a payload)."""
         with self._cv:
             slot = self._op_slot(op_id)
             slot["in"][rank] = payload
             if len(slot["in"]) == self._world:
-                arrs = [slot["in"][r] for r in range(self._world)]
-                if compute == "reduce":
-                    slot["out"] = REDUCE_OPS[op](arrs)
-                elif compute == "gather":
-                    slot["out"] = arrs
-                elif compute == "reducescatter":
-                    red = REDUCE_OPS[op](arrs)
-                    slot["out"] = np.array_split(red, self._world, axis=0)
+                if compute == "bcast":
+                    slot["out"] = slot["in"][int(op)]
                 elif compute == "barrier":
                     slot["out"] = True
+                else:
+                    arrs = [slot["in"][r] for r in range(self._world)]
+                    if compute == "reduce":
+                        slot["out"] = REDUCE_OPS[op](arrs)
+                    elif compute == "gather":
+                        slot["out"] = arrs
+                    elif compute == "reducescatter":
+                        red = REDUCE_OPS[op](arrs)
+                        slot["out"] = np.array_split(red, self._world, axis=0)
                 self._cv.notify_all()
             else:
                 deadline = time.time() + self._timeout
@@ -198,9 +202,11 @@ def reducescatter(tensor, group_name: str = "default", op: str = "sum"):
 
 def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
     g = _get_group(group_name)
-    gathered = ray_tpu.get(g.coord.collect.remote(
-        g.next_op("bc"), g.rank, np.asarray(tensor), "gather"))
-    return np.asarray(gathered[src_rank])
+    # only the source ships bytes; other ranks contribute a placeholder
+    payload = np.asarray(tensor) if g.rank == src_rank else None
+    out = ray_tpu.get(g.coord.collect.remote(
+        g.next_op("bc"), g.rank, payload, "bcast", str(src_rank)))
+    return np.asarray(out)
 
 
 def barrier(group_name: str = "default") -> None:

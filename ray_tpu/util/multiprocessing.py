@@ -36,6 +36,9 @@ class AsyncResult:
         return len(done) == len(self._refs)
 
     def successful(self) -> bool:
+        if not self.ready():
+            # multiprocessing contract: raise if the result isn't in yet
+            raise ValueError("AsyncResult not ready")
         try:
             ray_tpu.get(self._refs, timeout=0)
             return True

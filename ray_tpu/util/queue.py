@@ -2,6 +2,12 @@
 
 Reference: python/ray/util/queue.py (Queue — actor-backed, blocking
 put/get with timeouts, qsize/empty/full).
+
+The actor side is strictly NON-blocking (try_put/try_get return
+immediately); blocking semantics live client-side as a poll loop. A
+blocking server method would pin one of the actor's max_concurrency thread
+slots per waiter, and enough blocked getters would starve every putter —
+the classic thread-pool deadlock.
 """
 
 from __future__ import annotations
@@ -12,6 +18,8 @@ from collections import deque
 from typing import Any, List, Optional
 
 import ray_tpu
+
+_POLL_S = 0.01
 
 
 class Empty(Exception):
@@ -28,43 +36,29 @@ class _QueueActor:
         self._max = maxsize
         self._q: deque = deque()
         self._lock = threading.Lock()
-        self._cv = threading.Condition(self._lock)
 
-    def put(self, item, timeout: Optional[float] = None) -> bool:
-        with self._cv:
-            deadline = None if timeout is None else time.time() + timeout
-            while self._max > 0 and len(self._q) >= self._max:
-                left = None if deadline is None else deadline - time.time()
-                if left is not None and left <= 0:
-                    return False
-                self._cv.wait(timeout=min(left, 1.0) if left else 1.0)
+    def try_put(self, item) -> bool:
+        with self._lock:
+            if self._max > 0 and len(self._q) >= self._max:
+                return False
             self._q.append(item)
-            self._cv.notify_all()
             return True
 
-    def get(self, timeout: Optional[float] = None):
-        with self._cv:
-            deadline = None if timeout is None else time.time() + timeout
-            while not self._q:
-                left = None if deadline is None else deadline - time.time()
-                if left is not None and left <= 0:
-                    return ("__empty__",)
-                self._cv.wait(timeout=min(left, 1.0) if left else 1.0)
-            item = self._q.popleft()
-            self._cv.notify_all()
-            return ("__item__", item)
+    def try_get(self):
+        with self._lock:
+            if not self._q:
+                return ("__empty__",)
+            return ("__item__", self._q.popleft())
 
     def qsize(self) -> int:
         with self._lock:
             return len(self._q)
 
     def drain(self, max_items: int) -> List[Any]:
-        with self._cv:
+        with self._lock:
             out = []
             while self._q and len(out) < max_items:
                 out.append(self._q.popleft())
-            if out:
-                self._cv.notify_all()
             return out
 
 
@@ -72,25 +66,31 @@ class Queue:
     def __init__(self, maxsize: int = 0, actor_options: Optional[dict] = None):
         opts = dict(actor_options or {})
         opts.setdefault("num_cpus", 0)
-        opts.setdefault("max_concurrency", 16)
+        opts.setdefault("max_concurrency", 8)
         self.maxsize = maxsize
         self._actor = _QueueActor.options(**opts).remote(maxsize)
 
     def put(self, item, block: bool = True, timeout: Optional[float] = None):
-        ok = ray_tpu.get(self._actor.put.remote(
-            item, timeout if block else 0.0))
-        if not ok:
-            raise Full("queue full")
+        deadline = None if timeout is None else time.time() + timeout
+        while True:
+            if ray_tpu.get(self._actor.try_put.remote(item)):
+                return
+            if not block or (deadline is not None and time.time() >= deadline):
+                raise Full("queue full")
+            time.sleep(_POLL_S)
 
     def put_nowait(self, item):
         self.put(item, block=False)
 
     def get(self, block: bool = True, timeout: Optional[float] = None):
-        res = ray_tpu.get(self._actor.get.remote(
-            timeout if block else 0.0))
-        if res[0] == "__empty__":
-            raise Empty("queue empty")
-        return res[1]
+        deadline = None if timeout is None else time.time() + timeout
+        while True:
+            res = ray_tpu.get(self._actor.try_get.remote())
+            if res[0] == "__item__":
+                return res[1]
+            if not block or (deadline is not None and time.time() >= deadline):
+                raise Empty("queue empty")
+            time.sleep(_POLL_S)
 
     def get_nowait(self):
         return self.get(block=False)

@@ -1,0 +1,161 @@
+"""ray_tpu.serve tests (reference model: python/ray/serve/tests/)."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def ray8():
+    ray_tpu.init(num_cpus=8)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def test_function_deployment_and_handle(ray8):
+    @serve.deployment
+    def echo(payload):
+        return {"echo": payload}
+
+    h = serve.run(echo.bind(), route_prefix=None)
+    assert h.remote({"x": 1}).result(timeout=10) == {"echo": {"x": 1}}
+
+
+def test_class_deployment_methods_and_replicas(ray8):
+    @serve.deployment(num_replicas=3)
+    class Model:
+        def __init__(self, scale):
+            self.scale = scale
+            self.count = 0
+
+        def __call__(self, x):
+            self.count += 1
+            return x * self.scale
+
+        def info(self):
+            return self.count
+
+    h = serve.run(Model.bind(10), route_prefix=None)
+    outs = [h.remote(i).result(timeout=10) for i in range(9)]
+    assert outs == [i * 10 for i in range(9)]
+    st = serve.status()
+    assert st["default"]["Model"]["num_replicas"] == 3
+    # method routing sugar
+    counts = [h.info.remote().result(timeout=10) for _ in range(3)]
+    assert all(isinstance(c, int) for c in counts)
+
+
+def test_model_composition(ray8):
+    @serve.deployment
+    class Preprocess:
+        def __call__(self, x):
+            return x + 1
+
+    @serve.deployment
+    class Combiner:
+        def __init__(self, pre):
+            self.pre = pre
+
+        def __call__(self, x):
+            y = self.pre.remote(x).result(timeout=10)
+            return y * 2
+
+    app = Combiner.bind(Preprocess.bind())
+    h = serve.run(app, route_prefix=None)
+    assert h.remote(5).result(timeout=15) == 12
+
+
+def test_http_proxy_roundtrip(ray8):
+    @serve.deployment
+    def classify(payload):
+        return {"label": "even" if payload["n"] % 2 == 0 else "odd"}
+
+    serve.run(classify.bind(), route_prefix="/classify")
+    port = serve.http_port()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/classify",
+        data=json.dumps({"n": 4}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=15) as resp:
+        body = json.loads(resp.read())
+    assert body == {"label": "even"}
+    # 404 for unknown route when no "/" route exists
+    req2 = urllib.request.Request(f"http://127.0.0.1:{port}/nope")
+    with pytest.raises(Exception):
+        urllib.request.urlopen(req2, timeout=15)
+
+
+def test_redeploy_updates_in_place(ray8):
+    @serve.deployment
+    def v(payload=None):
+        return "v1"
+
+    h = serve.run(v.bind(), route_prefix=None)
+    assert h.remote().result(timeout=10) == "v1"
+
+    @serve.deployment(name="v")
+    def v2(payload=None):
+        return "v2"
+
+    h2 = serve.run(v2.bind(), route_prefix=None)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if h2.remote().result(timeout=10) == "v2":
+            break
+        time.sleep(0.1)
+    assert h2.remote().result(timeout=10) == "v2"
+
+
+def test_autoscaling_up_and_down(ray8):
+    @serve.deployment(
+        autoscaling_config=serve.AutoscalingConfig(
+            min_replicas=1, max_replicas=3, target_ongoing_requests=1.0,
+            upscale_delay_s=0.2, downscale_delay_s=0.5,
+        ),
+        max_ongoing_requests=8,
+    )
+    def slow(payload=None):
+        time.sleep(0.4)
+        return "done"
+
+    h = serve.run(slow.bind(), route_prefix=None)
+    # flood: sustained ongoing > target -> scale up
+    resps = [h.remote() for _ in range(40)]
+    deadline = time.time() + 15
+    scaled_up = False
+    while time.time() < deadline:
+        n = serve.status()["default"]["slow"]["num_replicas"]
+        if n >= 2:
+            scaled_up = True
+            break
+        time.sleep(0.2)
+    assert scaled_up, "never scaled up under load"
+    for r in resps:
+        r.result(timeout=30)
+    # idle -> scale back toward min
+    deadline = time.time() + 20
+    scaled_down = False
+    while time.time() < deadline:
+        if serve.status()["default"]["slow"]["num_replicas"] == 1:
+            scaled_down = True
+            break
+        time.sleep(0.25)
+    assert scaled_down, "never scaled down when idle"
+
+
+def test_delete_application(ray8):
+    @serve.deployment
+    def f(p=None):
+        return 1
+
+    serve.run(f.bind(), name="appx", route_prefix=None)
+    assert "appx" in serve.status()
+    serve.delete("appx")
+    assert "appx" not in serve.status()
