@@ -1,0 +1,6 @@
+"""``python -m ray_tpu`` — the CLI entry point (reference: the `ray` CLI,
+python/ray/scripts/scripts.py)."""
+
+from ray_tpu.scripts.cli import main
+
+main()

@@ -430,6 +430,23 @@ class ClusterClient:
     def available_resources(self) -> Dict[str, float]:
         return self.gcs.call("available_resources")
 
+    # ------------------------------------------------------------ state API
+
+    def list_tasks(self, limit: int = 1000) -> List[dict]:
+        return self.gcs.call("list_tasks", {"limit": limit})
+
+    def list_actors(self) -> List[dict]:
+        return self.gcs.call("list_actors", {})
+
+    def list_placement_groups(self) -> List[dict]:
+        return self.gcs.call("list_placement_groups", {})
+
+    def list_objects(self, limit: int = 1000) -> List[dict]:
+        return self.store.list_entries(limit)
+
+    def summary(self) -> dict:
+        return self.gcs.call("summary", {})
+
     # ------------------------------------------------------------- kv store
 
     def kv_put(self, key: str, value):

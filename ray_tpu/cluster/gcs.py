@@ -310,6 +310,15 @@ class GcsServer:
                 for a in self.actors.values()
             ]
 
+    def rpc_list_placement_groups(self, p, conn):
+        with self._lock:
+            return [
+                {"placement_group_id": pid,
+                 **{k: v for k, v in pg.items()
+                    if k in ("state", "strategy", "bundles")}}
+                for pid, pg in self.placement_groups.items()
+            ]
+
     def rpc_summary(self, p, conn):
         with self._lock:
             return {

@@ -35,6 +35,21 @@ class MemoryStore:
             self._store[ref.id] = _Entry(value, is_exception)
             self._cv.notify_all()
 
+    def list_entries(self, limit: int = 1000):
+        """State-API view (reference: `ray list objects`)."""
+        import sys
+
+        out = []
+        with self._lock:
+            for oid, e in list(self._store.items())[:limit]:
+                out.append({
+                    "object_id": oid,
+                    "is_exception": e.is_exception,
+                    "approx_size": sys.getsizeof(e.value),
+                    "type": type(e.value).__name__,
+                })
+        return out
+
     def contains(self, ref: ObjectRef) -> bool:
         with self._lock:
             return ref.id in self._store

@@ -663,6 +663,47 @@ class LocalRuntime:
     def timeline(self) -> List[dict]:
         return list(self._task_events)
 
+    # -------------------------------------------------- state API (local)
+    # reference: python/ray/util/state served from GCS task events
+
+    def list_tasks(self, limit: int = 1000) -> List[dict]:
+        return list(self._task_events)[-limit:]
+
+    def list_actors(self) -> List[dict]:
+        out = []
+        with self._lock:
+            for aid, st in self._actors.items():
+                out.append({
+                    "actor_id": aid,
+                    "state": "DEAD" if st.dead else "ALIVE",
+                    "node_id": self.node_id,
+                    "class_name": type(st.instance).__name__ if st.instance else "",
+                    "name": "",
+                })
+        return out
+
+    def list_placement_groups(self) -> List[dict]:
+        with self._lock:
+            return [
+                {"placement_group_id": pid, **{k: v for k, v in pg.items()
+                                               if k in ("state", "strategy", "bundles")}}
+                for pid, pg in self._pgs.items()
+            ]
+
+    def list_objects(self, limit: int = 1000) -> List[dict]:
+        return self.store.list_entries(limit)
+
+    def summary(self) -> dict:
+        with self._lock:
+            return {
+                "nodes_alive": 1,
+                "nodes_dead": 0,
+                "tasks_pending": len(self._pending) + len(self._waiting),
+                "tasks_running": len(self._running),
+                "actors": len(self._actors),
+                "placement_groups": len(self._pgs),
+            }
+
     def current_task_id(self) -> Optional[str]:
         spec = getattr(_context, "task", None)
         return spec.task_id if spec else None

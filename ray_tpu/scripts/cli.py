@@ -1,0 +1,244 @@
+"""CLI: start/stop/status/list/summary/timeline/memory/microbenchmark.
+
+Reference: python/ray/scripts/scripts.py (`ray start --head`,
+`ray start --address`, `ray stop`, `ray status`, `ray list ...`,
+`ray summary`, `ray timeline`, `ray memory`, `ray microbenchmark`).
+Invoke as ``python -m ray_tpu <command>``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+_SESSION_DIR = "/tmp/ray_tpu"
+_ADDR_FILE = os.path.join(_SESSION_DIR, "address")
+_PID_FILE = os.path.join(_SESSION_DIR, "pids")
+
+
+def _resolve_address(args) -> str:
+    addr = getattr(args, "address", None) or os.environ.get("RAY_TPU_ADDRESS")
+    if not addr and os.path.exists(_ADDR_FILE):
+        addr = open(_ADDR_FILE).read().strip()
+    if not addr:
+        sys.exit("no cluster address (use --address, RAY_TPU_ADDRESS, or "
+                 "start a head node on this machine first)")
+    return addr
+
+
+def _record_pid(pid: int):
+    os.makedirs(_SESSION_DIR, exist_ok=True)
+    with open(_PID_FILE, "a") as f:
+        f.write(f"{pid}\n")
+
+
+def cmd_start(args):
+    res = {"CPU": float(args.num_cpus)}
+    if args.num_tpus:
+        res["TPU"] = float(args.num_tpus)
+    if args.memory:
+        res["memory"] = float(args.memory)
+    if args.resources:
+        res.update(json.loads(args.resources))
+
+    if not args.block:
+        # daemonize: re-exec ourselves with --block in the background
+        if args.head:
+            # a stale address file from a crashed head would be mistaken for
+            # the new head's address in the wait loop below
+            try:
+                os.remove(_ADDR_FILE)
+            except OSError:
+                pass
+        cmd = [sys.executable, "-m", "ray_tpu"] + sys.argv[1:] + ["--block"]
+        proc = subprocess.Popen(
+            cmd, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            start_new_session=True,
+        )
+        _record_pid(proc.pid)
+        # wait for the address file (head) or just report (worker)
+        if args.head:
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                if os.path.exists(_ADDR_FILE):
+                    addr = open(_ADDR_FILE).read().strip()
+                    print(f"ray_tpu head started at {addr} (pid {proc.pid})")
+                    print(f"connect with: ray_tpu.init(address={addr!r})")
+                    return
+                time.sleep(0.1)
+            sys.exit("head did not come up within 15s")
+        print(f"ray_tpu node started (pid {proc.pid})")
+        return
+
+    # --block: run the node in THIS process
+    from ray_tpu.cluster.gcs import GcsServer
+    from ray_tpu.cluster.node_daemon import NodeDaemon
+
+    if args.head:
+        gcs = GcsServer(host="127.0.0.1", port=args.port or 0)
+        addr = f"127.0.0.1:{gcs.port}"
+        os.makedirs(_SESSION_DIR, exist_ok=True)
+        with open(_ADDR_FILE, "w") as f:
+            f.write(addr)
+        daemon = NodeDaemon(("127.0.0.1", gcs.port), res, host="127.0.0.1")
+        print(f"head up at {addr}")
+    else:
+        host, port = _resolve_address(args).rsplit(":", 1)
+        daemon = NodeDaemon((host, int(port)), res, host="127.0.0.1")
+        print(f"node joined {host}:{port}")
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    while not stop:
+        time.sleep(0.5)
+    daemon.shutdown()
+    if args.head:
+        gcs.shutdown()
+        try:
+            os.remove(_ADDR_FILE)
+        except OSError:
+            pass
+
+
+def cmd_stop(args):
+    n = 0
+    if os.path.exists(_PID_FILE):
+        for line in open(_PID_FILE):
+            try:
+                os.kill(int(line.strip()), signal.SIGTERM)
+                n += 1
+            except (OSError, ValueError):
+                pass
+        os.remove(_PID_FILE)
+    for f in (_ADDR_FILE,):
+        try:
+            os.remove(f)
+        except OSError:
+            pass
+    print(f"stopped {n} process(es)")
+
+
+def _connect(args):
+    import ray_tpu
+
+    ray_tpu.init(address=_resolve_address(args), ignore_reinit_error=True)
+    return ray_tpu
+
+
+def cmd_status(args):
+    rt = _connect(args)
+    from ray_tpu.util import state
+
+    s = state.summary()
+    print("cluster summary:")
+    for k, v in s.items():
+        print(f"  {k:<20}{v}")
+    print("resources:")
+    total = rt.cluster_resources()
+    avail = rt.available_resources()
+    for k in sorted(total):
+        print(f"  {k:<12}{avail.get(k, 0):>12.1f} / {total[k]:.1f}")
+
+
+def cmd_list(args):
+    _connect(args)
+    from ray_tpu.util import state
+
+    kind = args.kind
+    fn = {
+        "tasks": lambda: state.list_tasks(args.limit),
+        "actors": state.list_actors,
+        "nodes": state.list_nodes,
+        "objects": lambda: state.list_objects(args.limit),
+        "placement-groups": state.list_placement_groups,
+    }[kind]
+    rows = fn()
+    print(json.dumps(rows, indent=1, default=str))
+
+
+def cmd_summary(args):
+    _connect(args)
+    from ray_tpu.util import state
+
+    print(json.dumps(state.summarize_tasks(), indent=1))
+
+
+def cmd_timeline(args):
+    _connect(args)
+    from ray_tpu.util.state import dump_timeline
+
+    out = args.output or f"timeline_{int(time.time())}.json"
+    dump_timeline(out)
+    print(f"wrote chrome trace to {out} (open in chrome://tracing or Perfetto)")
+
+
+def cmd_memory(args):
+    _connect(args)
+    from ray_tpu.util import state
+
+    objs = state.list_objects(args.limit)
+    total = sum(o.get("approx_size", 0) for o in objs)
+    print(f"{len(objs)} objects, ~{total/1e6:.1f} MB (driver-visible)")
+    for o in objs[:50]:
+        print(f"  {o['object_id'][:16]:<18}{o['type']:<16}{o['approx_size']:>10}")
+
+
+def cmd_microbenchmark(args):
+    from ray_tpu.scripts.ray_perf import main as perf_main
+
+    perf_main(address=getattr(args, "address", None), quick=args.quick)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="ray_tpu", description=__doc__)
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sp = sub.add_parser("start", help="start a head or worker node")
+    sp.add_argument("--head", action="store_true")
+    sp.add_argument("--address", help="head address for worker nodes")
+    sp.add_argument("--port", type=int, default=0)
+    sp.add_argument("--num-cpus", type=float, default=os.cpu_count() or 4)
+    sp.add_argument("--num-tpus", type=float, default=0)
+    sp.add_argument("--memory", type=float, default=0)
+    sp.add_argument("--resources", help="extra resources as JSON")
+    sp.add_argument("--block", action="store_true")
+    sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("stop", help="stop locally started nodes")
+    sp.set_defaults(fn=cmd_stop)
+
+    for name, fn in (("status", cmd_status), ("summary", cmd_summary),
+                     ("timeline", cmd_timeline), ("memory", cmd_memory)):
+        sp = sub.add_parser(name)
+        sp.add_argument("--address")
+        sp.add_argument("--limit", type=int, default=1000)
+        if name == "timeline":
+            sp.add_argument("-o", "--output")
+        sp.set_defaults(fn=fn)
+
+    sp = sub.add_parser("list", help="list tasks/actors/nodes/objects/placement-groups")
+    sp.add_argument("kind", choices=["tasks", "actors", "nodes", "objects",
+                                     "placement-groups"])
+    sp.add_argument("--address")
+    sp.add_argument("--limit", type=int, default=1000)
+    sp.set_defaults(fn=cmd_list)
+
+    sp = sub.add_parser("microbenchmark", help="single-node perf quick check")
+    sp.add_argument("--address")
+    sp.add_argument("--quick", action="store_true")
+    sp.set_defaults(fn=cmd_microbenchmark)
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

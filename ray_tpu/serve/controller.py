@@ -25,6 +25,7 @@ class _DeploymentState:
         self.scale_signal_since: Optional[float] = None
         self.scale_signal_dir = 0
         self.next_replica_id = 0
+        self.replica_ids: List[int] = []  # parallel to self.replicas
         # replica_id -> (ongoing, timestamp), pushed by replicas
         self.stats: Dict[int, tuple] = {}
 
@@ -47,15 +48,24 @@ class ServeController:
             if ingress is not None:
                 self._ingress[app_name] = ingress
             app = self._apps.setdefault(app_name, {})
+            new_names = {spec["name"] for spec in specs}
+            # deployments dropped from the app spec are torn down (reference:
+            # deployment_state reconciles the FULL target set)
+            for name in list(app):
+                if name not in new_names:
+                    for r in list(app[name].replicas):
+                        self._kill_replica(app[name], r)
+                    del app[name]
             for spec in specs:
                 name = spec["name"]
                 old = app.get(name)
                 if old is not None:
                     # in-place update: new code/config, replace replicas
-                    for r in old.replicas:
-                        self._kill(r)
+                    for r in list(old.replicas):
+                        self._kill_replica(old, r)
                     old.spec = spec
                     old.replicas = []
+                    old.replica_ids = []
                     old.target = spec["num_replicas"]
                     old.version += 1
                 else:
@@ -67,8 +77,8 @@ class ServeController:
         with self._lock:
             app = self._apps.pop(app_name, {})
             for st in app.values():
-                for r in st.replicas:
-                    self._kill(r)
+                for r in list(st.replicas):
+                    self._kill_replica(st, r)
         return True
 
     def shutdown(self):
@@ -76,8 +86,8 @@ class ServeController:
             self._stop = True
             for app in self._apps.values():
                 for st in app.values():
-                    for r in st.replicas:
-                        self._kill(r)
+                    for r in list(st.replicas):
+                        self._kill_replica(st, r)
             self._apps.clear()
         return True
 
@@ -123,6 +133,23 @@ class ServeController:
         except Exception:
             pass
 
+    def _kill_replica(self, st: "_DeploymentState", replica):
+        """Kill + retire: drop the rid from live set and stats so a leaked
+        metrics thread (daemon threads can't be interrupted in local mode)
+        can never re-register a dead replica into autoscaling."""
+        try:
+            idx = st.replicas.index(replica)
+        except ValueError:
+            idx = -1
+        if idx >= 0 and idx < len(st.replica_ids):
+            rid = st.replica_ids[idx]
+            st.stats.pop(rid, None)
+        try:
+            replica.stop_metrics.remote()  # best-effort thread stop
+        except Exception:
+            pass
+        self._kill(replica)
+
     def _reconcile_locked(self):
         for app_name, deps in self._apps.items():
             for name, st in deps.items():
@@ -146,11 +173,13 @@ class ServeController:
                                 identity=(app_name, name, rid),
                             )
                         )
+                        st.replica_ids.append(rid)
                     st.version += 1
                 elif delta < 0:
-                    for r in st.replicas[st.target:]:
-                        self._kill(r)
+                    for r in list(st.replicas[st.target:]):
+                        self._kill_replica(st, r)
                     st.replicas = st.replicas[: st.target]
+                    st.replica_ids = st.replica_ids[: st.target]
                     st.version += 1
 
     # --------------------------------------------------------- autoscaling
@@ -166,7 +195,7 @@ class ServeController:
         app_name, dep_name, rid = identity
         with self._lock:
             st = self._apps.get(app_name, {}).get(dep_name)
-            if st is not None:
+            if st is not None and rid in st.replica_ids:
                 st.stats[rid] = (ongoing, time.time())
         return True
 

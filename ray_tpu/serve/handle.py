@@ -31,6 +31,11 @@ class DeploymentResponse:
 
 
 class DeploymentHandle:
+    # how often a hot handle re-checks the replica-set version with the
+    # controller (reference: router long-polls; a per-request RPC would make
+    # the controller a global bottleneck)
+    VERSION_CHECK_INTERVAL_S = 0.5
+
     def __init__(self, deployment_name: str, app_name: str = "default",
                  method_name: Optional[str] = None):
         self.deployment_name = deployment_name
@@ -39,7 +44,9 @@ class DeploymentHandle:
         self._lock = threading.Lock()
         self._replicas: List = []
         self._replica_version = -1
-        self._inflight: Dict[int, List] = {}  # replica idx -> pending refs
+        self._last_version_check = 0.0
+        self._inflight: Dict[str, List] = {}  # replica actor_id -> pending refs
+        self._method_handles: Dict[str, "DeploymentHandle"] = {}
         self._rng = random.Random()
 
     # picklable: handles travel into other replicas for composition
@@ -62,9 +69,25 @@ class DeploymentHandle:
         with self._lock:
             self._replicas = info["replicas"]
             self._replica_version = info["version"]
-            self._inflight = {i: [] for i in range(len(self._replicas))}
+            live = {r._actor_id for r in self._replicas}
+            self._inflight = {
+                aid: refs for aid, refs in self._inflight.items() if aid in live
+            }
 
-    def _maybe_refresh(self):
+    def _maybe_refresh(self, force: bool = False):
+        import time as _time
+
+        now = _time.time()
+        with self._lock:
+            due = (
+                force
+                or self._replica_version < 0
+                or now - self._last_version_check > self.VERSION_CHECK_INTERVAL_S
+            )
+            if due:
+                self._last_version_check = now
+        if not due:
+            return
         from ray_tpu.serve.api import _get_controller
 
         ctrl = _get_controller()
@@ -74,9 +97,11 @@ class DeploymentHandle:
         if v != self._replica_version:
             self._refresh_replicas()
 
-    def _pick_replica(self) -> int:
+    def _pick_replica(self):
         """Power of two choices on locally-observed in-flight counts
-        (reference: pow_2_scheduler.py)."""
+        (reference: pow_2_scheduler.py). Returns the replica handle —
+        chosen and read under ONE lock so a concurrent refresh can't
+        invalidate the index."""
         with self._lock:
             n = len(self._replicas)
             if n == 0:
@@ -84,29 +109,40 @@ class DeploymentHandle:
                     f"deployment {self.deployment_name} has no replicas"
                 )
             # prune completed refs
-            for i, refs in self._inflight.items():
+            for aid, refs in self._inflight.items():
                 if refs:
                     done, pending = ray_tpu.wait(
                         refs, num_returns=len(refs), timeout=0
                     )
-                    self._inflight[i] = list(pending)
+                    self._inflight[aid] = list(pending)
             if n == 1:
-                return 0
+                return self._replicas[0]
             a, b = self._rng.sample(range(n), 2)
-            return a if len(self._inflight[a]) <= len(self._inflight[b]) else b
+            ra, rb = self._replicas[a], self._replicas[b]
+            la = len(self._inflight.get(ra._actor_id, ()))
+            lb = len(self._inflight.get(rb._actor_id, ()))
+            return ra if la <= lb else rb
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         self._maybe_refresh()
-        idx = self._pick_replica()
-        with self._lock:
-            replica = self._replicas[idx]
+        try:
+            replica = self._pick_replica()
+        except RuntimeError:
+            self._maybe_refresh(force=True)  # empty set may be stale
+            replica = self._pick_replica()
         ref = replica.handle_request.remote(self._method_name, args, kwargs)
         with self._lock:
-            self._inflight.setdefault(idx, []).append(ref)
+            self._inflight.setdefault(replica._actor_id, []).append(ref)
         return DeploymentResponse(ref)
 
     def __getattr__(self, name: str):
         if name.startswith("_"):
             raise AttributeError(name)
-        # handle.method.remote(...) sugar (reference: handle.method_name)
-        return self.options(method_name=name)
+        # handle.method.remote(...) sugar — cached so repeated calls keep
+        # their router state instead of refreshing per access
+        with self._lock:
+            h = self._method_handles.get(name)
+            if h is None:
+                h = self.options(method_name=name)
+                self._method_handles[name] = h
+            return h

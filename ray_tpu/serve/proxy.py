@@ -33,7 +33,8 @@ class HTTPProxy:
                     length = int(self.headers.get("Content-Length") or 0)
                     body = self.rfile.read(length) if length else b""
                     payload = json.loads(body) if body else None
-                    handle = proxy._match(self.path)
+                    path = self.path.split("?", 1)[0]  # match sans query string
+                    handle = proxy._match(path)
                     if handle is None:
                         self.send_response(404)
                         self.end_headers()

@@ -35,12 +35,17 @@ class ServeReplica:
         # replica.py pushes to the controller): a poll through the mailbox
         # would queue behind pending requests and always observe drained
         # state.
+        self._metrics_stopped = False
         if identity is not None:
             self._identity = identity
             threading.Thread(
                 target=self._push_metrics_loop, args=(metrics_period_s,),
                 daemon=True,
             ).start()
+
+    def stop_metrics(self):
+        self._metrics_stopped = True
+        return True
 
     def _push_metrics_loop(self, period: float):
         import time as _time
@@ -52,8 +57,8 @@ class ServeReplica:
         ctrl = None
         while True:
             _time.sleep(period)
-            if _api._runtime is not rt0:
-                return  # runtime shut down or replaced; this replica is dead
+            if self._metrics_stopped or _api._runtime is not rt0:
+                return  # replica retired, or runtime shut down/replaced
             try:
                 if ctrl is None:
                     ctrl = _rt.get_actor("serve:controller")

@@ -77,7 +77,12 @@ class Queue:
                 return
             if not block or (deadline is not None and time.time() >= deadline):
                 raise Full("queue full")
-            time.sleep(_POLL_S)
+            # while full, poll the (tiny) qsize instead of re-shipping the
+            # item payload on every attempt
+            while self.maxsize > 0 and ray_tpu.get(self._actor.qsize.remote()) >= self.maxsize:
+                if deadline is not None and time.time() >= deadline:
+                    raise Full("queue full")
+                time.sleep(_POLL_S)
 
     def put_nowait(self, item):
         self.put(item, block=False)

@@ -1,0 +1,52 @@
+"""State listing functions (reference: python/ray/util/state/api.py)."""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+from ray_tpu.core import api as _api
+
+
+def _rt():
+    return _api._get_runtime()
+
+
+def list_tasks(limit: int = 1000, filters: Optional[Dict] = None) -> List[dict]:
+    tasks = _rt().list_tasks(limit)
+    if filters:
+        tasks = [
+            t for t in tasks
+            if all(t.get(k) == v for k, v in filters.items())
+        ]
+    return tasks
+
+
+def list_actors() -> List[dict]:
+    return _rt().list_actors()
+
+
+def list_nodes() -> List[dict]:
+    return _rt().nodes()
+
+
+def list_objects(limit: int = 1000) -> List[dict]:
+    return _rt().list_objects(limit)
+
+
+def list_placement_groups() -> List[dict]:
+    return _rt().list_placement_groups()
+
+
+def summary() -> dict:
+    return _rt().summary()
+
+
+def summarize_tasks() -> Dict[str, dict]:
+    """Per-task-name counts by status (reference: `ray summary tasks`)."""
+    agg: Dict[str, dict] = defaultdict(lambda: defaultdict(int))
+    for t in _rt().list_tasks(100000):
+        name = t.get("name") or "unknown"
+        agg[name][t.get("status", "UNKNOWN")] += 1
+        agg[name]["total"] += 1
+    return {k: dict(v) for k, v in agg.items()}

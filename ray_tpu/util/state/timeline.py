@@ -1,0 +1,46 @@
+"""Chrome-trace timeline export.
+
+Reference: `ray timeline` (python/ray/scripts/scripts.py timeline command)
+— task events rendered in the chrome://tracing / Perfetto "trace events"
+JSON format, one row per node/actor lane.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional
+
+from ray_tpu.core import api as _api
+
+
+def chrome_trace(events: Optional[List[dict]] = None) -> List[dict]:
+    """Convert task events to Chrome trace 'X' (complete) events."""
+    if events is None:
+        events = _api._get_runtime().timeline()
+    trace = []
+    for e in events:
+        start = e.get("start")
+        end = e.get("end")
+        if start is None or end is None:
+            continue
+        lane = e.get("actor_id") or e.get("worker_id") or "tasks"
+        trace.append({
+            "name": e.get("name") or e.get("task_id", "task"),
+            "cat": "actor_task" if e.get("actor_id") else "task",
+            "ph": "X",
+            "ts": start * 1e6,  # chrome trace wants microseconds
+            "dur": max((end - start) * 1e6, 1.0),
+            "pid": e.get("node") or e.get("node_id") or "node",
+            "tid": lane,
+            "args": {
+                "task_id": e.get("task_id"),
+                "status": e.get("status"),
+            },
+        })
+    return trace
+
+
+def dump_timeline(path: str, events: Optional[List[dict]] = None) -> str:
+    with open(path, "w") as f:
+        json.dump(chrome_trace(events), f)
+    return path

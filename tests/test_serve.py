@@ -159,3 +159,38 @@ def test_delete_application(ray8):
     assert "appx" in serve.status()
     serve.delete("appx")
     assert "appx" not in serve.status()
+
+
+def test_redeploy_removes_absent_deployments(ray8):
+    """Regression: deployments dropped from the app spec are torn down."""
+    @serve.deployment
+    class A:
+        def __call__(self, p=None):
+            return "a"
+
+    @serve.deployment
+    class B:
+        def __init__(self, a):
+            self.a = a
+
+        def __call__(self, p=None):
+            return "b" + self.a.remote().result(timeout=10)
+
+    serve.run(B.bind(A.bind()), route_prefix=None)
+    assert set(serve.status()["default"]) == {"A", "B"}
+    serve.run(A.bind(), route_prefix=None)
+    assert set(serve.status()["default"]) == {"A"}
+
+
+def test_http_get_with_query_string(ray8):
+    """Regression: the route matcher strips the query string."""
+    @serve.deployment
+    def ping(payload=None):
+        return {"ok": True}
+
+    serve.run(ping.bind(), route_prefix="/ping")
+    port = serve.http_port()
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/ping?x=1", timeout=15
+    ) as resp:
+        assert json.loads(resp.read()) == {"ok": True}

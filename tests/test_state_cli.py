@@ -1,0 +1,119 @@
+"""State API + CLI + timeline tests (reference: python/ray/tests for
+`ray list`/`ray summary`/`ray timeline`, util/state tests)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def ray4():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_state_lists_local(ray4):
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    def work(x):
+        return x + 1
+
+    @ray_tpu.remote
+    class Keeper:
+        def get(self):
+            return 1
+
+    k = Keeper.remote()
+    ray_tpu.get([work.remote(i) for i in range(5)])
+    ray_tpu.get(k.get.remote())
+
+    tasks = state.list_tasks()
+    assert any(t["name"] == "work" for t in tasks)
+    actors = state.list_actors()
+    assert any(a["state"] == "ALIVE" for a in actors)
+    nodes = state.list_nodes()
+    assert len(nodes) == 1
+    objs = state.list_objects()
+    assert len(objs) >= 5
+    s = state.summary()
+    assert s["actors"] == 1
+    summ = state.summarize_tasks()
+    assert summ["work"]["FINISHED"] == 5
+
+
+def test_timeline_chrome_trace(ray4, tmp_path):
+    from ray_tpu.util.state import chrome_trace, dump_timeline
+
+    @ray_tpu.remote
+    def step():
+        time.sleep(0.01)
+
+    ray_tpu.get([step.remote() for _ in range(3)])
+    trace = chrome_trace()
+    assert len(trace) >= 3
+    ev = next(e for e in trace if e["name"] == "step")
+    assert ev["ph"] == "X" and ev["dur"] > 0
+    out = dump_timeline(str(tmp_path / "t.json"))
+    data = json.load(open(out))
+    assert isinstance(data, list) and data
+
+
+def test_state_lists_cluster():
+    from ray_tpu.cluster.cluster_utils import Cluster
+    from ray_tpu.util import state
+
+    cluster = Cluster()
+    cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=2)
+    ray_tpu.init(address=cluster.address)
+    try:
+        @ray_tpu.remote
+        def f():
+            return 1
+
+        ray_tpu.get([f.remote() for _ in range(4)])
+        nodes = state.list_nodes()
+        assert len(nodes) == 2
+        tasks = state.list_tasks()
+        assert tasks
+        s = state.summary()
+        assert s["nodes_alive"] == 2
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_cli_end_to_end(tmp_path):
+    """Drive the CLI like a user: start head (daemonized), status, list,
+    microbenchmark, stop (reference: `ray start --head` flow)."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        + os.pathsep + env.get("PYTHONPATH", "")
+    )
+    run = lambda *args, **kw: subprocess.run(
+        [sys.executable, "-m", "ray_tpu", *args],
+        capture_output=True, text=True, timeout=120, env=env, **kw,
+    )
+    # make the session dir private to this test
+    out = run("start", "--head", "--num-cpus", "2")
+    assert "head started" in out.stdout, out.stdout + out.stderr
+    addr = out.stdout.split("at ")[1].split(" ")[0]
+    try:
+        st = run("status", "--address", addr)
+        assert "cluster summary" in st.stdout, st.stdout + st.stderr
+        ls = run("list", "nodes", "--address", addr)
+        assert "NodeID" in ls.stdout or "node" in ls.stdout.lower()
+        mb = run("microbenchmark", "--address", addr, "--quick")
+        assert "tasks_per_second" in mb.stdout, mb.stdout + mb.stderr
+    finally:
+        stop = run("stop")
+        assert "stopped" in stop.stdout
