@@ -53,12 +53,62 @@ class ClusterClient:
         self._actor_cache: Dict[str, dict] = {}
         self._actor_queues: Dict[str, Any] = {}
         self._daemon_conns: Dict[str, RpcClient] = {}
+        self._gcs_host, self._gcs_port = host, port
+        self._closed = False
         self.gcs.subscribe("task_result", self._on_task_result)
         self.gcs.subscribe("actor_update", self._on_actor_update)
         self.gcs.subscribe("nodes", self._on_nodes)
+        self.gcs.on_close = self._on_gcs_lost
         reply = self.gcs.call("register_driver", {"driver_id": self.worker_id})
         self._nodes: Dict[str, dict] = reply["nodes"]
         self._put_rr = 0
+
+    # -------------------------------------------------- GCS reconnection
+
+    def _on_gcs_lost(self):
+        if self._closed:
+            return
+        threading.Thread(
+            target=self._gcs_reconnect_loop, daemon=True,
+            name="driver-gcs-reconnect",
+        ).start()
+
+    def _gcs_reconnect_loop(self):
+        """Reconnect to a restarted GCS and resubmit unfinished tasks
+        (at-least-once across a control-plane restart; reference: GCS FT
+        with workers reconnecting/resubscribing)."""
+        import time as _time
+
+        deadline = _time.time() + self.config.gcs_reconnect_timeout_s
+        while not self._closed and _time.time() < deadline:
+            _time.sleep(0.2)
+            try:
+                gcs = RpcClient(self._gcs_host, self._gcs_port)
+                gcs.subscribe("task_result", self._on_task_result)
+                gcs.subscribe("actor_update", self._on_actor_update)
+                gcs.subscribe("nodes", self._on_nodes)
+                gcs.on_close = self._on_gcs_lost
+                reply = gcs.call("register_driver", {"driver_id": self.worker_id})
+            except OSError:
+                continue
+            with self._lock:
+                self._nodes = reply["nodes"]
+                unfinished = []
+                for tid, meta in self._task_meta.items():
+                    if meta.get("actor_creation") or meta.get("actor_id"):
+                        continue
+                    first_out = ObjectRef.for_task_output(
+                        tid, 0, owner=self.worker_id
+                    )
+                    if not self.store.contains(first_out):
+                        unfinished.append(dict(meta))
+            self.gcs = gcs
+            for meta in unfinished:
+                try:
+                    gcs.call("submit_task", meta)
+                except Exception:
+                    pass
+            return
 
     # ----------------------------------------------------------- submission
 
@@ -214,7 +264,10 @@ class ClusterClient:
             if meta.get("retries_left", 0) > 0:
                 meta["retries_left"] -= 1
                 try:
-                    self.gcs.call("submit_task", meta)
+                    # MUST be async: this runs on the rpc reader thread, and
+                    # a blocking call() would deadlock waiting for a response
+                    # only this same thread can read
+                    self.gcs.call_async("submit_task", meta)
                     return
                 except Exception:
                     pass
@@ -479,6 +532,7 @@ class ClusterClient:
         return None
 
     def shutdown(self):
+        self._closed = True
         for q in self._actor_queues.values():
             q.put(None)
         for c in self._daemon_conns.values():

@@ -18,11 +18,29 @@ from ray_tpu.cluster.node_daemon import NodeDaemon
 
 
 class Cluster:
-    def __init__(self, config: Optional[Config] = None, host: str = "127.0.0.1"):
+    def __init__(self, config: Optional[Config] = None, host: str = "127.0.0.1",
+                 persistence_path: Optional[str] = None):
         self.config = config or Config()
         self.host = host
-        self.gcs = GcsServer(host=host, config=self.config)
+        self.persistence_path = persistence_path
+        self.gcs = GcsServer(
+            host=host, config=self.config, persistence_path=persistence_path
+        )
         self.daemons = []
+
+    def restart_gcs(self):
+        """Kill and restart the GCS at the SAME port from its persisted
+        tables (reference: GCS fault tolerance with Redis persistence;
+        test_gcs_fault_tolerance.py). Daemons and drivers reconnect via
+        their on_close reconnect loops."""
+        port = self.gcs.port
+        self.gcs.shutdown()
+        time.sleep(0.3)  # let the port free + disconnects propagate
+        self.gcs = GcsServer(
+            host=self.host, port=port, config=self.config,
+            persistence_path=self.persistence_path,
+        )
+        return self.gcs
 
     @property
     def address(self) -> str:

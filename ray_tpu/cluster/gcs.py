@@ -29,7 +29,9 @@ from ray_tpu.sched import bundles as bundles_mod
 
 
 class GcsServer:
-    def __init__(self, host: str = "127.0.0.1", port: int = 0, config: Optional[Config] = None):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 config: Optional[Config] = None,
+                 persistence_path: Optional[str] = None):
         self.config = config or Config()
         self.space = ResourceSpace()
         self.state = NodeResourceState(space=self.space)
@@ -45,6 +47,14 @@ class GcsServer:
         self.directory: Dict[str, set] = defaultdict(set)  # object_id -> {node_id}
         self.drivers: Dict[int, dict] = {}  # conn_id -> {driver_id}
         self.task_events: deque = deque(maxlen=100000)
+
+        # --- persistence (reference: Redis-backed gcs_table_storage for GCS
+        # fault tolerance; file-backed snapshot here) ---
+        self.persistence_path = persistence_path
+        # (pg_id, bundle, node_id) allocations to re-apply as nodes rejoin
+        self._pending_bundle_reapply: List[tuple] = []
+        if persistence_path:
+            self._load_tables()
 
         # --- scheduler state ---
         self.pending: deque = deque()  # (spec_meta dict)
@@ -67,6 +77,83 @@ class GcsServer:
             target=self._health_loop, daemon=True, name="gcs-health"
         )
         self._health_thread.start()
+        if self.persistence_path:
+            self._persist_thread = threading.Thread(
+                target=self._persist_loop, daemon=True, name="gcs-persist"
+            )
+            self._persist_thread.start()
+
+    # ------------------------------------------------------- persistence
+
+    def _snapshot_tables(self) -> dict:
+        with self._lock:
+            return {
+                "kv": dict(self.kv),
+                "jobs": {k: dict(v) for k, v in self.jobs.items()},
+                "placement_groups": {
+                    k: dict(v) for k, v in self.placement_groups.items()
+                },
+                "actors": {
+                    k: {kk: vv for kk, vv in v.items() if kk != "conn"}
+                    for k, v in self.actors.items()
+                },
+            }
+
+    def _persist_now(self):
+        import os
+        import pickle
+
+        snap = self._snapshot_tables()
+        tmp = self.persistence_path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(snap, f)
+        os.replace(tmp, self.persistence_path)
+
+    def _persist_loop(self):
+        while not self._stopped:
+            time.sleep(0.5)
+            try:
+                self._persist_now()
+            except Exception:
+                traceback.print_exc()
+
+    def _load_tables(self):
+        import os
+        import pickle
+
+        if not os.path.exists(self.persistence_path):
+            return
+        with open(self.persistence_path, "rb") as f:
+            snap = pickle.load(f)
+        self.kv = snap.get("kv", {})
+        self.jobs = snap.get("jobs", {})
+        self.placement_groups = snap.get("placement_groups", {})
+        # actors come back location-known but unconfirmed; a node re-sync
+        # (rpc_node_sync) flips them ALIVE again (reference: GCS restart +
+        # raylet reconnect rebuilds the actor table)
+        self.actors = snap.get("actors", {})
+        for a in self.actors.values():
+            if a.get("state") == "ALIVE":
+                a["state"] = "RESTARTING_GCS"
+        # CREATED PG bundle allocations must be re-applied to the fresh
+        # scheduler state as their nodes re-register
+        for pid, pg in self.placement_groups.items():
+            if pg.get("state") == "CREATED" and pg.get("nodes"):
+                for b, nid in zip(pg["bundles"], pg["nodes"]):
+                    self._pending_bundle_reapply.append((pid, b, nid))
+
+    def _reapply_bundles_for_node(self, node_id: str):
+        """Called under lock when a node (re)registers."""
+        idx = self.state.node_index(node_id)
+        if idx is None:
+            return
+        remaining = []
+        for pid, b, nid in self._pending_bundle_reapply:
+            if nid == node_id:
+                self.state.allocate(idx, self.space.vector(b))
+            else:
+                remaining.append((pid, b, nid))
+        self._pending_bundle_reapply = remaining
 
     # ------------------------------------------------------------------ rpc
 
@@ -97,9 +184,32 @@ class GcsServer:
             else:
                 # re-registration after a death: revive the scheduler row
                 self.state.revive_node(node_id, p["resources"])
+            # restored-from-snapshot PG bundles land on this node's row
+            self._reapply_bundles_for_node(node_id)
             self._publish_nodes()
         self._kick()
         return {"ok": True, "node_index": self.state.node_index(node_id)}
+
+    def rpc_node_sync(self, p, conn):
+        """Daemon re-sync after a GCS restart/reconnect: re-report hosted
+        actors and stored objects (reference: raylet re-registration +
+        ownership re-publish after GCS FT restart)."""
+        with self._lock:
+            node_id = p["node_id"]
+            for actor_id in p.get("actor_ids", []):
+                a = self.actors.get(actor_id)
+                if a is None:
+                    self.actors[actor_id] = {
+                        "actor_id": actor_id, "node_id": node_id,
+                        "state": "ALIVE", "max_restarts": 0, "restarts": 0,
+                        "class_name": "", "name": "",
+                    }
+                else:
+                    a["node_id"] = node_id
+                    a["state"] = "ALIVE"
+            for oid in p.get("object_ids", []):
+                self.directory[oid].add(node_id)
+        return {"ok": True}
 
     def rpc_heartbeat(self, p, conn):
         with self._lock:
@@ -688,5 +798,10 @@ class GcsServer:
 
     def shutdown(self):
         self._stopped = True
+        if self.persistence_path:
+            try:
+                self._persist_now()
+            except Exception:
+                pass
         self._kick()
         self.server.stop()

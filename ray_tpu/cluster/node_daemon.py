@@ -105,6 +105,10 @@ class ObjectStore:
         with self._lock:
             return oid in self._data or oid in self._spilled
 
+    def object_ids(self) -> List[str]:
+        with self._lock:
+            return list(self._data) + list(self._spilled)
+
     def delete(self, oids: List[str]):
         with self._cv:
             for oid in oids:
@@ -175,23 +179,66 @@ class NodeDaemon:
         )
         self.port = self.server.start()
 
-        self.gcs = RpcClient(gcs_addr[0], gcs_addr[1])
-        self.gcs.subscribe("exec_task", self._on_exec_task)
-        self.gcs.subscribe("kill_actor", self._on_kill_actor)
-        self.gcs.subscribe("free_objects", lambda p: self.store.delete(p["object_ids"]))
-        self.gcs.subscribe("commit_bundle", self._on_commit_bundle)
-        self.gcs.subscribe("nodes", self._on_nodes_update)
+        self._gcs_addr = gcs_addr
+        self._labels = dict(labels or {})
         self._nodes_snapshot: Dict[str, dict] = {}
-        reply = self.gcs.call("register_node", {
-            "node_id": self.node_id, "addr": host, "port": self.port,
-            "resources": resources, "labels": labels or {},
-        })
-        assert reply["ok"]
         self._stopped = False
+        self.gcs = self._connect_gcs()
         self._beat_thread = threading.Thread(
             target=self._heartbeat_loop, daemon=True, name="daemon-beat"
         )
         self._beat_thread.start()
+
+    # ------------------------------------------------- GCS (re)connection
+
+    def _connect_gcs(self) -> RpcClient:
+        gcs = RpcClient(self._gcs_addr[0], self._gcs_addr[1])
+        gcs.subscribe("exec_task", self._on_exec_task)
+        gcs.subscribe("kill_actor", self._on_kill_actor)
+        gcs.subscribe("free_objects", lambda p: self.store.delete(p["object_ids"]))
+        gcs.subscribe("commit_bundle", self._on_commit_bundle)
+        gcs.subscribe("nodes", self._on_nodes_update)
+        gcs.on_close = self._on_gcs_lost
+        reply = gcs.call("register_node", {
+            "node_id": self.node_id, "addr": self.host, "port": self.port,
+            "resources": self.resources, "labels": self._labels,
+        })
+        assert reply["ok"]
+        return gcs
+
+    def _on_gcs_lost(self):
+        """GCS connection dropped: reconnect + re-sync (reference: raylet
+        reconnect/resubscribe after GCS fault-tolerant restart)."""
+        if self._stopped:
+            return
+        threading.Thread(
+            target=self._gcs_reconnect_loop, daemon=True,
+            name="daemon-gcs-reconnect",
+        ).start()
+
+    def _gcs_reconnect_loop(self):
+        deadline = time.time() + self.config.gcs_reconnect_timeout_s
+        while not self._stopped and time.time() < deadline:
+            time.sleep(0.2)
+            try:
+                gcs = self._connect_gcs()
+            except OSError:
+                continue
+            # re-sync node-local state into the fresh GCS tables
+            with self._lock:
+                actor_ids = [
+                    w.actor_id for w in self.workers.values() if w.actor_id
+                ]
+            try:
+                gcs.call("node_sync", {
+                    "node_id": self.node_id,
+                    "actor_ids": actor_ids,
+                    "object_ids": self.store.object_ids(),
+                })
+            except Exception:
+                pass
+            self.gcs = gcs
+            return
 
     # ------------------------------------------------------------ worker pool
 

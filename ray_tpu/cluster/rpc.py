@@ -203,7 +203,20 @@ class RpcServer:
         def _stop():
             if self._server:
                 self._server.close()
-            self.loop.stop()
+            # abort every client socket: peers detect the shutdown
+            # edge-triggered (a stopped loop alone sends no FIN, leaving
+            # clients blocked in recv forever — no reconnect would ever
+            # fire). abort() sends RST immediately, no flush cycle needed.
+            for conn in list(self.conns.values()):
+                try:
+                    conn.writer.transport.abort()
+                except Exception:
+                    try:
+                        conn.writer.close()
+                    except Exception:
+                        pass
+            # one extra loop tick so the aborts are processed before stop
+            self.loop.call_later(0.05, self.loop.stop)
 
         try:
             self.loop.call_soon_threadsafe(_stop)

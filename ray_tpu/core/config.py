@@ -30,6 +30,9 @@ _DEFS: Dict[str, tuple] = {
     "object_transfer_chunk_bytes": (int, 1024 * 1024),
     "memory_monitor_interval_ms": (float, 500.0),
     "gcs_port": (int, 0),  # 0 -> pick free port
+    # daemons/drivers retry re-connecting to a restarted GCS for this long
+    # (reference: gcs_rpc_server_reconnect_timeout_s)
+    "gcs_reconnect_timeout_s": (float, 30.0),
     "num_workers_soft_limit": (int, 0),  # 0 -> num_cpus
     "worker_start_timeout_s": (float, 30.0),
     "metrics_report_interval_ms": (float, 2000.0),
