@@ -30,6 +30,60 @@ from ray_tpu.core.task_spec import TaskSpec, new_id
 from ray_tpu.cluster.rpc import ConnectionLost, RpcClient
 
 
+class _ActorQueue:
+    """Seq-ordered per-actor submit queue (reference: actor_submit_queue.h
+    sequence numbers). Replayed calls re-enter at their ORIGINAL sequence
+    number with a small backoff, so a bounced call never executes after a
+    call submitted later."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._heap: list = []  # (seq, not_before, (meta, refs))
+        self._next_seq = 0
+        self._closed = False
+
+    def put(self, meta, refs) -> int:
+        import heapq
+
+        with self._cv:
+            seq = self._next_seq
+            self._next_seq += 1
+            heapq.heappush(self._heap, (seq, 0.0, (meta, refs)))
+            self._cv.notify()
+        return seq
+
+    def put_replay(self, seq: int, meta, refs, delay: float):
+        import heapq
+
+        with self._cv:
+            heapq.heappush(self._heap, (seq, time.time() + delay, (meta, refs)))
+            self._cv.notify()
+
+    def close(self):
+        with self._cv:
+            self._closed = True
+            self._cv.notify()
+
+    def get(self):
+        """Blocks for the lowest-seq item; honors its not-before time rather
+        than skipping ahead (order beats latency here). None = closed."""
+        import heapq
+
+        with self._cv:
+            while True:
+                if self._closed:
+                    return None
+                if self._heap:
+                    seq, not_before, item = self._heap[0]
+                    now = time.time()
+                    if not_before <= now:
+                        heapq.heappop(self._heap)
+                        return seq, item
+                    self._cv.wait(timeout=not_before - now)
+                else:
+                    self._cv.wait()
+
+
 def _parse_address(address) -> Tuple[str, int]:
     if isinstance(address, tuple):
         return address
@@ -175,9 +229,7 @@ class ClusterClient:
         with self._lock:
             q = self._actor_queues.get(spec.actor_id)
             if q is None:
-                import queue as _queue
-
-                q = _queue.Queue()
+                q = _ActorQueue()
                 self._actor_queues[spec.actor_id] = q
                 t = threading.Thread(
                     target=self._actor_dispatch_loop,
@@ -186,14 +238,14 @@ class ClusterClient:
                     name=f"actor-dispatch-{spec.actor_id[:8]}",
                 )
                 t.start()
-        q.put((meta, refs))
+        q.put(meta, refs)
 
-    def _actor_dispatch_loop(self, actor_id: str, q):
+    def _actor_dispatch_loop(self, actor_id: str, q: _ActorQueue):
         while True:
-            item = q.get()
-            if item is None:
+            got = q.get()
+            if got is None:
                 return
-            meta, refs = item
+            seq, (meta, refs) = got
 
             def fail(err, refs=refs):
                 for r in refs:
@@ -210,21 +262,30 @@ class ClusterClient:
                 fail(ActorDiedError(f"actor call failed: {e!r}"))
                 continue
 
-            def on_done(f, refs=refs):
+            def on_done(f, seq=seq, meta=meta, refs=refs, actor_id=actor_id):
                 try:
-                    self._ingest_result(f.result(), refs)
+                    p = f.result()
                 except (ConnectionLost, OSError) as e:
+                    # daemon died with the call possibly mid-execution:
+                    # at-most-once — fail, never replay (reference: actor
+                    # calls in flight at death get ActorDiedError)
                     for r in refs:
                         self.store.put(
                             r, ActorDiedError(f"actor node unreachable: {e}"),
                             is_exception=True,
                         )
+                    return
                 except Exception as e:  # noqa: BLE001
                     for r in refs:
                         self.store.put(
                             r, TaskError(f"actor call failed: {e!r}"),
                             is_exception=True,
                         )
+                    return
+                if p.get("status") == "ACTOR_UNREACHABLE" and \
+                        self._maybe_replay_actor_call(actor_id, seq, meta, refs):
+                    return
+                self._ingest_result(p, refs)
 
             fut.add_done_callback(on_done)
 
@@ -249,9 +310,39 @@ class ClusterClient:
 
     def _on_actor_update(self, p):
         with self._lock:
-            info = self._actor_cache.get(p["actor_id"])
-            if info is not None:
-                info["state"] = p["state"]
+            if p.get("state") == "DEAD":
+                info = self._actor_cache.get(p["actor_id"])
+                if info is not None:
+                    info["state"] = "DEAD"
+            else:
+                # RESTARTING/ALIVE: the actor may come back on a different
+                # node — drop the cache so the next call re-resolves
+                self._actor_cache.pop(p["actor_id"], None)
+
+    def _maybe_replay_actor_call(self, actor_id: str, seq: int, meta: dict,
+                                 refs) -> bool:
+        """Hold-and-replay during restart (reference: actor_task_submitter.cc
+        queues calls while the actor is RESTARTING). Only routing misses —
+        calls the daemon could not deliver to a worker — are replayed; they
+        re-enter the queue at their original seq with a backoff so a
+        restarting actor has time to surface in the GCS table."""
+        n = meta.get("_replays", 0)
+        if n >= 10:
+            return False
+        try:
+            info = self.gcs.call("get_actor", {"actor_id": actor_id})
+        except Exception:  # noqa: BLE001
+            return False
+        if not info or info.get("state") == "DEAD":
+            return False
+        meta["_replays"] = n + 1
+        with self._lock:
+            self._actor_cache.pop(actor_id, None)
+            q = self._actor_queues.get(actor_id)
+        if q is None:
+            return False
+        q.put_replay(seq, meta, refs, delay=min(0.25 * (n + 1), 1.0))
+        return True
 
     # ------------------------------------------------------------- results
 
@@ -298,9 +389,14 @@ class ClusterClient:
                     self._result_ready[r.id] = {"node_id": p["node_id"]}
                 self.store.put(r, ("__remote__", p["node_id"]), is_exception=False)
             elif p.get("status") not in ("FINISHED", None):
+                err_cls = (
+                    ActorDiedError
+                    if p.get("status") in ("ACTOR_DEAD", "ACTOR_UNREACHABLE")
+                    else TaskError
+                )
                 self.store.put(
                     r,
-                    TaskError(f"task failed: {p.get('error')}"),
+                    err_cls(f"task failed: {p.get('error')}"),
                     is_exception=True,
                 )
 
@@ -534,7 +630,7 @@ class ClusterClient:
     def shutdown(self):
         self._closed = True
         for q in self._actor_queues.values():
-            q.put(None)
+            q.close()
         for c in self._daemon_conns.values():
             c.close()
         self.gcs.close()

@@ -242,6 +242,12 @@ class GcsServer:
         with self._lock:
             p["owner_conn"] = conn.conn_id
             p["enqueued_at"] = time.time()
+            if p.get("actor_creation"):
+                # keep the creation spec for restart-on-death (reference:
+                # gcs_actor_manager.cc retains the creation task spec)
+                a = self.actors.get(p.get("actor_id"))
+                if a is not None:
+                    a["creation_meta"] = dict(p)
             self.pending.append(p)
         self._kick()
         return {"ok": True}
@@ -267,11 +273,40 @@ class GcsServer:
                                        "start", "end", "actor_id")}
             )
             owner_conn = info["owner_conn"] if info else p.get("owner_conn")
+            alive_actor = None
+            kill_on_node = None
             if p.get("actor_creation") and p.get("actor_id"):
                 a = self.actors.get(p["actor_id"])
                 if a is not None:
-                    a["state"] = "ALIVE" if p["status"] == "FINISHED" else "DEAD"
+                    if p["status"] == "FINISHED":
+                        if a["state"] == "DEAD":
+                            # killed while this creation was in flight: undo
+                            # the hold and tear the fresh worker down
+                            hold = self.running.pop(
+                                f"actor-hold-{p['actor_id']}", None
+                            )
+                            if hold is not None:
+                                idx = self.state.node_index(hold["node_id"])
+                                if idx is not None:
+                                    self.state.release(idx, hold["demand"])
+                            kill_on_node = p["node_id"]
+                        else:
+                            a["state"] = "ALIVE"
+                            alive_actor = p["actor_id"]
+                    elif a["state"] == "STARTING":
+                        # failed creation; a concurrent actor_died may have
+                        # queued a restart (RESTARTING) — don't clobber it
+                        a["state"] = "DEAD"
             target = self._driver_conn(owner_conn)
+        if kill_on_node is not None:
+            self._push_to_node(
+                kill_on_node, "kill_actor", {"actor_id": p["actor_id"]}
+            )
+        if alive_actor is not None:
+            # clients drop stale location caches and resume held calls
+            self.server.broadcast(
+                "actor_update", {"actor_id": alive_actor, "state": "ALIVE"}
+            )
         if target is not None:
             self.server.call_soon(
                 lambda: __import__("asyncio").ensure_future(
@@ -347,11 +382,41 @@ class GcsServer:
     def rpc_actor_died(self, p, conn):
         with self._lock:
             a = self.actors.get(p["actor_id"])
-            if a:
-                a["state"] = "DEAD"
-                a["death_cause"] = p.get("cause", "")
-        self.server.broadcast("actor_update", {"actor_id": p["actor_id"], "state": "DEAD"})
+            if a is None:
+                return {"ok": True}
+            restarting = self._maybe_restart_actor_locked(a, p.get("cause", ""))
+        self.server.broadcast("actor_update", {
+            "actor_id": p["actor_id"],
+            "state": "RESTARTING" if restarting else "DEAD",
+        })
+        if restarting:
+            self._kick()
         return {"ok": True}
+
+    def _maybe_restart_actor_locked(self, a: dict, cause: str) -> bool:
+        """Restart path (reference: gcs_actor_manager.cc — decrement the
+        restart budget, requeue the retained creation spec, flip state
+        DEAD->RESTARTING; clients hold-and-replay while RESTARTING). Returns
+        True when a restart was queued. Caller holds self._lock."""
+        aid = a["actor_id"]
+        # the alive actor's lifetime resource hold is released either way
+        info = self.running.pop(f"actor-hold-{aid}", None)
+        if info is not None:
+            idx = self.state.node_index(info["node_id"])
+            if idx is not None and self.state.alive[idx]:
+                self.state.release(idx, info["demand"])
+        meta = a.get("creation_meta")
+        max_restarts = a.get("max_restarts", 0)
+        budget_left = max_restarts == -1 or a.get("restarts", 0) < max_restarts
+        if meta is None or not budget_left:
+            a["state"] = "DEAD"
+            a["death_cause"] = cause
+            return False
+        a["restarts"] = a.get("restarts", 0) + 1
+        a["state"] = "RESTARTING"
+        a["node_id"] = None
+        self.pending.append(dict(meta))
+        return True
 
     def rpc_kill_actor(self, p, conn):
         with self._lock:
@@ -557,6 +622,10 @@ class GcsServer:
             # split off strategy-constrained tasks (node affinity / PG bundle)
             default_batch, special = [], []
             for t in batch:
+                if t.get("actor_creation"):
+                    a = self.actors.get(t.get("actor_id"))
+                    if a is not None and a["state"] == "DEAD":
+                        continue  # killed while pending/restarting: drop
                 if t.get("strategy", {}).get("kind") in ("NODE_AFFINITY", "PLACEMENT_GROUP"):
                     special.append(t)
                 else:
@@ -767,11 +836,26 @@ class GcsServer:
                 a for a in self.actors.values()
                 if a["node_id"] == node_id and a["state"] in ("ALIVE", "STARTING")
             ]
+            actor_updates = []
+            restarted_actor_ids = set()
             for a in dead_actors:
-                a["state"] = "DEAD"
-                a["death_cause"] = f"node {node_id} died: {cause}"
+                if self._maybe_restart_actor_locked(
+                    a, f"node {node_id} died: {cause}"
+                ):
+                    actor_updates.append((a["actor_id"], "RESTARTING"))
+                    restarted_actor_ids.add(a["actor_id"])
+                else:
+                    actor_updates.append((a["actor_id"], "DEAD"))
             self._publish_nodes()
         for tid, info in lost_tasks:
+            # GCS owns actor FT: an in-flight creation task for an actor it
+            # is restarting must not also be retried by the driver
+            if tid.startswith("actor-hold-"):
+                continue  # lifetime holds, not real tasks; actor FT above
+            meta = info.get("meta", {})
+            if meta.get("actor_creation") and \
+                    meta.get("actor_id") in restarted_actor_ids:
+                continue
             target = self._driver_conn(info["owner_conn"])
             if target is not None:
                 payload = {
@@ -783,9 +867,9 @@ class GcsServer:
                         t.push("task_result", pl)
                     )
                 )
-        for a in dead_actors:
+        for aid, state in actor_updates:
             self.server.broadcast(
-                "actor_update", {"actor_id": a["actor_id"], "state": "DEAD"}
+                "actor_update", {"actor_id": aid, "state": state}
             )
         self._kick()
 

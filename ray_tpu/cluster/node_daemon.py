@@ -193,6 +193,10 @@ class NodeDaemon:
 
     def _connect_gcs(self) -> RpcClient:
         gcs = RpcClient(self._gcs_addr[0], self._gcs_addr[1])
+        # Publish the client on self BEFORE subscribing: a task pushed the
+        # instant register_node lands would otherwise hit handlers (e.g.
+        # _spawn_worker -> self.gcs.host) before __init__'s assignment runs.
+        self.gcs = gcs
         gcs.subscribe("exec_task", self._on_exec_task)
         gcs.subscribe("kill_actor", self._on_kill_actor)
         gcs.subscribe("free_objects", lambda p: self.store.delete(p["object_ids"]))
@@ -456,7 +460,9 @@ class NodeDaemon:
             if fut is not None:
                 self.server.call_soon(
                     lambda: fut.set_result({
-                        "status": "ACTOR_DEAD", "task_id": t["task_id"],
+                        # routing miss (actor moved/restarting) — the client
+                        # re-resolves the location and replays the call
+                        "status": "ACTOR_UNREACHABLE", "task_id": t["task_id"],
                         "node_id": self.node_id, "results": [], "inline": {},
                         "error": f"actor {aid} not on node {self.node_id}",
                     }) if not fut.done() else None

@@ -105,7 +105,7 @@ def test_chaos_node_churn_under_load():
 def test_object_spilling_over_capacity():
     """Store capacity forces LRU spill to disk; spilled objects restore on
     get (reference: test_object_spilling.py)."""
-    cfg = Config(_overrides={"object_store_memory_bytes": 2 * 1024 * 1024})
+    cfg = Config(overrides={"object_store_memory_bytes": 2 * 1024 * 1024})
     cluster = Cluster(config=cfg)
     cluster.add_node(num_cpus=2)
     ray_tpu.init(address=cluster.address)
@@ -155,6 +155,67 @@ def test_actor_restart_after_worker_kill():
             except Exception:
                 time.sleep(0.2)
         assert pid2 is not None and pid2 != pid1
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_actor_restart_after_node_death():
+    """Actors pinned to a dying node restart on a surviving node
+    (reference: gcs_actor_manager.cc OnNodeDead -> restart)."""
+    cluster = Cluster()
+    cluster.add_node(num_cpus=2)
+    victim = cluster.add_node(num_cpus=2, resources={"victim": 1})
+    ray_tpu.init(address=cluster.address)
+    try:
+        @ray_tpu.remote(max_restarts=1, resources={"victim": 0.001})
+        class Pinned:
+            def where(self):
+                return os.getpid()
+
+        # soft resource pin lands the actor on the victim node
+        a = Pinned.remote()
+        pid1 = ray_tpu.get(a.where.remote(), timeout=15.0)
+        cluster.kill_node(victim)
+        # creation spec demands the "victim" resource: the restart stays
+        # pending until a node that has it joins (requeue-until-feasible)
+        cluster.add_node(num_cpus=2, resources={"victim": 1})
+        deadline = time.time() + 25
+        pid2 = None
+        while time.time() < deadline:
+            try:
+                pid2 = ray_tpu.get(a.where.remote(), timeout=5.0)
+                break
+            except Exception:
+                time.sleep(0.2)
+        assert pid2 is not None and pid2 != pid1
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_actor_no_restart_when_budget_exhausted():
+    """max_restarts=0 actors stay dead; calls raise (reference semantics)."""
+    cluster = Cluster()
+    cluster.add_node(num_cpus=2)
+    ray_tpu.init(address=cluster.address)
+    try:
+        @ray_tpu.remote
+        class Mortal:
+            def die(self):
+                os._exit(1)
+
+            def ping(self):
+                return "pong"
+
+        a = Mortal.remote()
+        assert ray_tpu.get(a.ping.remote(), timeout=15.0) == "pong"
+        try:
+            ray_tpu.get(a.die.remote(), timeout=10.0)
+        except Exception:
+            pass
+        with pytest.raises(Exception):
+            ray_tpu.get(a.ping.remote(), timeout=10.0)
     finally:
         ray_tpu.shutdown()
         cluster.shutdown()
