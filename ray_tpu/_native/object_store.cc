@@ -365,7 +365,11 @@ uint8_t* rts_base(int64_t h) {
   return m ? m->arena : nullptr;
 }
 
-int64_t rts_obj_create(int64_t h, const uint8_t* id, uint64_t size) {
+// allow_evict=0 returns -2 instead of silently dropping LRU objects, so an
+// owner that layers disk spilling on top (reference: local_object_manager.cc)
+// gets to persist victims before the space is reused.
+int64_t rts_obj_create2(int64_t h, const uint8_t* id, uint64_t size,
+                        int allow_evict) {
   Mapping* m = get_mapping(h);
   if (!m) return -1;
   Lock lock(&m->hdr->mutex);
@@ -376,6 +380,7 @@ int64_t rts_obj_create(int64_t h, const uint8_t* id, uint64_t size) {
   // evict_lru counts freed bytes that may be non-contiguous; keep evicting
   // until the allocation fits or nothing evictable remains
   while (off == kNil) {
+    if (!allow_evict) return -2;  // no entry written yet: clean abort
     if (evict_lru(*m, align_up(size + sizeof(AllocHeader), kAlign)) == 0)
       return -2;
     off = arena_alloc(*m, size);
@@ -390,6 +395,10 @@ int64_t rts_obj_create(int64_t h, const uint8_t* id, uint64_t size) {
   e.lru_tick = ++m->hdr->lru_counter;
   m->hdr->n_objects += 1;
   return (int64_t)off;
+}
+
+int64_t rts_obj_create(int64_t h, const uint8_t* id, uint64_t size) {
+  return rts_obj_create2(h, id, size, /*allow_evict=*/1);
 }
 
 int rts_obj_seal(int64_t h, const uint8_t* id) {

@@ -107,6 +107,7 @@ class ClusterClient:
         self._actor_cache: Dict[str, dict] = {}
         self._actor_queues: Dict[str, Any] = {}
         self._daemon_conns: Dict[str, RpcClient] = {}
+        self._shm_conns: Dict[str, Any] = {}  # node_id -> ShmClientStore|False
         self._gcs_host, self._gcs_port = host, port
         self._closed = False
         self.gcs.subscribe("task_result", self._on_task_result)
@@ -241,6 +242,20 @@ class ClusterClient:
         q.put(meta, refs)
 
     def _actor_dispatch_loop(self, actor_id: str, q: _ActorQueue):
+        # Calls pipeline freely while they target one daemon connection
+        # (frame order = execution order there). Before switching to a NEW
+        # node (restart/relocation) the loop drains all in-flight calls, so
+        # a bounced call replayed at its original seq can never execute
+        # after a later-seq call that raced onto the new node.
+        inflight: set = set()
+        flight_cv = threading.Condition()
+        last_node: List[Optional[str]] = [None]
+
+        def _done(seq):
+            with flight_cv:
+                inflight.discard(seq)
+                flight_cv.notify_all()
+
         while True:
             got = q.get()
             if got is None:
@@ -256,13 +271,23 @@ class ClusterClient:
                 if info is None or info.get("state") == "DEAD":
                     fail(ActorDiedError(f"actor {actor_id} is dead"))
                     continue
+                if info["node_id"] != last_node[0]:
+                    with flight_cv:
+                        deadline = time.time() + 60
+                        while inflight and time.time() < deadline:
+                            flight_cv.wait(timeout=1.0)
+                    last_node[0] = info["node_id"]
                 daemon = self._daemon(info["node_id"], info["addr"], info["port"])
+                with flight_cv:
+                    inflight.add(seq)
                 fut = daemon.call_async("actor_call", meta)
             except (ConnectionLost, OSError, Exception) as e:  # noqa: BLE001
+                _done(seq)
                 fail(ActorDiedError(f"actor call failed: {e!r}"))
                 continue
 
             def on_done(f, seq=seq, meta=meta, refs=refs, actor_id=actor_id):
+                _done(seq)
                 try:
                     p = f.result()
                 except (ConnectionLost, OSError) as e:
@@ -402,6 +427,30 @@ class ClusterClient:
 
     # --------------------------------------------------------------- objects
 
+    def _local_shm(self, node_id: str):
+        """Same-host shm attachment for a node, or None (segment names are
+        node-unique, so attach succeeds only on the daemon's own host —
+        reference: plasma client connecting to the local store only)."""
+        with self._lock:
+            cached = self._shm_conns.get(node_id)
+            if cached is not None:
+                return cached or None
+            info = self._nodes.get(node_id) or {}
+            name = info.get("shm_name")
+        if not name:
+            # node metadata not here yet: don't negative-cache — the nodes
+            # broadcast may still be in flight
+            return None
+        try:
+            from ray_tpu.cluster.shm_store import ShmClientStore
+
+            seg = ShmClientStore(name)
+        except Exception:  # noqa: BLE001 - remote host / no native build
+            seg = None
+        with self._lock:
+            self._shm_conns[node_id] = seg or False
+        return seg
+
     def put(self, value: Any) -> ObjectRef:
         ref = ObjectRef(owner=self.worker_id)
         payload = serialization.pack({"e": False, "v": value})
@@ -412,7 +461,14 @@ class ClusterClient:
             self.store.put(ref, value)
             return ref
         daemon = self._daemon(node["node_id"], node["addr"], node["port"])
-        daemon.call("put_object", {"object_id": ref.id, "payload": payload})
+        seg = self._local_shm(node["node_id"])
+        stored = False
+        if seg is not None:
+            stored = seg.put_with_make_room(ref.id, payload, daemon)
+            if stored:
+                daemon.call("note_object", {"object_id": ref.id})
+        if not stored:
+            daemon.call("put_object", {"object_id": ref.id, "payload": payload})
         self.store.put(ref, value)  # local cache
         return ref
 
@@ -451,6 +507,15 @@ class ClusterClient:
         while time.time() < deadline:
             loc = self.gcs.call("locate_object", {"object_id": ref.id})
             for entry in loc.get("nodes", []):
+                seg = self._local_shm(entry["node_id"])
+                if seg is not None:
+                    payload = seg.get_bytes(ref.id)
+                    if payload is not None:
+                        rec = serialization.unpack(payload)
+                        self.store.put(ref, rec["v"], is_exception=rec["e"])
+                        if rec["e"]:
+                            raise rec["v"]
+                        return rec["v"]
                 daemon = self._daemon(entry["node_id"], entry["addr"], entry["port"])
                 try:
                     payload = daemon.call(

@@ -177,6 +177,7 @@ class GcsServer:
                 "conn_id": conn.conn_id,
                 "last_beat": time.time(),
                 "labels": p.get("labels", {}),
+                "shm_name": p.get("shm_name"),
             }
             conn.meta["node_id"] = node_id
             if self.state.node_index(node_id) is None:
@@ -221,7 +222,8 @@ class GcsServer:
     def rpc_get_nodes(self, p, conn):
         with self._lock:
             return {
-                nid: {k: n[k] for k in ("addr", "port", "resources", "alive", "labels")}
+                nid: {k: n.get(k) for k in
+                      ("addr", "port", "resources", "alive", "labels", "shm_name")}
                 for nid, n in self.nodes.items()
             }
 
@@ -399,6 +401,10 @@ class GcsServer:
         DEAD->RESTARTING; clients hold-and-replay while RESTARTING). Returns
         True when a restart was queued. Caller holds self._lock."""
         aid = a["actor_id"]
+        if a.get("state") == "DEAD":
+            return False  # explicitly killed (ray.kill) — stays dead
+        if a.get("state") == "RESTARTING":
+            return True  # restart already queued; don't enqueue a duplicate
         # the alive actor's lifetime resource hold is released either way
         info = self.running.pop(f"actor-hold-{aid}", None)
         if info is not None:
@@ -875,7 +881,8 @@ class GcsServer:
 
     def _publish_nodes(self):
         snapshot = {
-            nid: {k: n[k] for k in ("addr", "port", "resources", "alive")}
+            nid: {k: n.get(k) for k in
+                  ("addr", "port", "resources", "alive", "shm_name")}
             for nid, n in self.nodes.items()
         }
         self.server.broadcast("nodes", snapshot)

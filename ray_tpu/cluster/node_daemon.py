@@ -162,7 +162,24 @@ class NodeDaemon:
         spill_root = self.config.object_spilling_dir or os.path.join(
             self.config.session_dir_root, "spill", self.node_id
         )
-        self.store = ObjectStore(self.config.object_store_memory_bytes, spill_root)
+        # The C++ shm segment is the node's data plane (reference: plasma
+        # runs inside the raylet); the dict store remains as a fallback when
+        # the native build is unavailable.
+        self.store: Any
+        try:
+            from ray_tpu.cluster.shm_store import ShmNodeStore
+
+            self.store = ShmNodeStore(
+                self.config.object_store_memory_bytes, spill_root,
+                name=f"/rt_{self.node_id[-12:]}_{os.getpid()}",
+            )
+            self.shm_name: Optional[str] = self.store.shm_name
+        except Exception:  # noqa: BLE001 - no toolchain / shm mount
+            traceback.print_exc()
+            self.store = ObjectStore(
+                self.config.object_store_memory_bytes, spill_root
+            )
+            self.shm_name = None
 
         self._lock = threading.Lock()
         self.workers: Dict[str, _Worker] = {}
@@ -206,6 +223,7 @@ class NodeDaemon:
         reply = gcs.call("register_node", {
             "node_id": self.node_id, "addr": self.host, "port": self.port,
             "resources": self.resources, "labels": self._labels,
+            "shm_name": self.shm_name,
         })
         assert reply["ok"]
         return gcs
@@ -254,6 +272,8 @@ class NodeDaemon:
         env["RAY_TPU_WORKER_ID"] = worker_id
         env["RAY_TPU_NODE_ID"] = self.node_id
         env["RAY_TPU_GCS_ADDR"] = f"{self.gcs.host}:{self.gcs.port}"
+        if self.shm_name:
+            env["RAY_TPU_SHM_NAME"] = self.shm_name
         env["PYTHONPATH"] = (
             os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
             + os.pathsep + env.get("PYTHONPATH", "")
@@ -330,9 +350,13 @@ class NodeDaemon:
         return {"ok": True, "node_id": self.node_id}
 
     def rpc_task_finished(self, p, conn):
-        """Worker -> daemon: results arrive as packed payload bytes."""
+        """Worker -> daemon: results arrive either already sealed in shm
+        (result_shm: [(oid, size)]) or as packed payload bytes (fallback)."""
         for oid, payload in p.get("result_payloads", {}).items():
             self.store.put(oid, payload)
+        if p.get("result_shm") and hasattr(self.store, "note"):
+            for oid, _size in p["result_shm"]:
+                self.store.note(oid)
         worker_id = conn.meta.get("worker_id")
         # actor calls are tracked by task id (several can be in flight on one
         # worker); pool tasks by the worker's current_task slot
@@ -348,9 +372,12 @@ class NodeDaemon:
                 w.busy = False
                 self._idle.append(worker_id)
         if t is not None:
+            results = [
+                (oid, len(pl)) for oid, pl in p.get("result_payloads", {}).items()
+            ] + [tuple(r) for r in p.get("result_shm", [])]
             self._report_done(
                 t, status=p.get("status", "FINISHED"), error=p.get("error"),
-                results=[(oid, len(pl)) for oid, pl in p.get("result_payloads", {}).items()],
+                results=results,
                 start=p.get("start"), end=p.get("end"),
             )
         self._pump()
@@ -376,6 +403,28 @@ class NodeDaemon:
         return self.server.loop.run_in_executor(
             None, lambda: self.store.get(p["object_id"], timeout=timeout)
         )
+
+    def rpc_make_room(self, p, conn):
+        """Attached writer (worker/driver) hit StoreFullError: spill LRU
+        objects so its retry can fit (reference: create_request_queue.cc
+        retrying creates after eviction/spill)."""
+        if hasattr(self.store, "make_room"):
+            freed = self.store.make_room(int(p["nbytes"]))
+            return {"ok": True, "freed": freed}
+        return {"ok": False, "freed": 0}
+
+    def rpc_note_object(self, p, conn):
+        """Attached writer sealed an object directly in shm: register it and
+        publish its location."""
+        if hasattr(self.store, "note"):
+            self.store.note(p["object_id"])
+        try:
+            self.gcs.call("add_object_location", {
+                "object_id": p["object_id"], "node_id": self.node_id,
+            })
+        except Exception:
+            pass
+        return {"ok": True}
 
     def rpc_put_object(self, p, conn):
         self.store.put(p["object_id"], p["payload"])
@@ -612,6 +661,11 @@ class NodeDaemon:
                     pass
         self.server.stop()
         self.gcs.close()
+        if hasattr(self.store, "close"):
+            try:
+                self.store.close()
+            except Exception:
+                pass
 
 
 def main():  # pragma: no cover - exercised as a subprocess

@@ -23,13 +23,44 @@ from ray_tpu.cluster.rpc import RpcClient
 
 _actor_instances = {}
 _actor_concurrency = {}
+_shm = None  # ShmClientStore when the daemon exposes a segment
 
 
-def _resolve(client: RpcClient, obj):
+def _attach_shm():
+    global _shm
+    name = os.environ.get("RAY_TPU_SHM_NAME")
+    if not name:
+        return
+    try:
+        from ray_tpu.cluster.shm_store import ShmClientStore
+
+        _shm = ShmClientStore(name)
+    except Exception:  # noqa: BLE001 - fall back to the daemon RPC path
+        _shm = None
+
+
+def _resolve(client: RpcClient, obj, pins=None):
+    """Arg resolution: same-node shm hit is a zero-copy mapped read
+    (reference: plasma client Get -> mmap view); miss falls back to the
+    daemon, which pulls from peers. When `pins` is given the shm object
+    stays pinned (appended for post-task release) and numpy buffers
+    deserialize as views into the segment; without it the payload is
+    copied — actor tasks use the copy path because actor state outlives
+    the task and must not dangle into an evictable segment."""
     if isinstance(obj, ObjectRef):
-        payload = client.call(
-            "get_object", {"object_id": obj.id, "timeout": 60.0}, timeout=90.0
-        )
+        payload = None
+        if _shm is not None:
+            if pins is not None:
+                view = _shm.get_view(obj.id)
+                if view is not None:
+                    pins.append(obj.id)
+                    payload = view
+            else:
+                payload = _shm.get_bytes(obj.id)
+        if payload is None:
+            payload = client.call(
+                "get_object", {"object_id": obj.id, "timeout": 60.0}, timeout=90.0
+            )
         if payload is None:
             raise RuntimeError(f"object {obj.id[:8]} unavailable")
         rec = serialization.unpack(payload)
@@ -51,10 +82,15 @@ def _execute(client: RpcClient, t: dict):
         ObjectRef.for_task_output(task_id, i).id for i in range(num_returns)
     ]
     # actor method calls derive output ids the same way on the driver side
+    pins = []
     try:
         spec = serialization.loads(t["spec_bytes"])
-        args = tuple(_resolve(client, a) for a in spec["args"])
-        kwargs = {k: _resolve(client, v) for k, v in spec["kwargs"].items()}
+        is_actor_task = bool(t.get("actor_creation") or t.get("actor_id"))
+        arg_pins = None if is_actor_task else pins
+        args = tuple(_resolve(client, a, arg_pins) for a in spec["args"])
+        kwargs = {
+            k: _resolve(client, v, arg_pins) for k, v in spec["kwargs"].items()
+        }
         if t.get("actor_creation"):
             cls = spec["func"]
             _actor_instances[t["actor_id"]] = cls(*args, **kwargs)
@@ -74,23 +110,40 @@ def _execute(client: RpcClient, t: dict):
             raise ValueError(
                 f"task returned {len(values)} values, expected {num_returns}"
             )
-        payloads = {oid: _pack_value(v) for oid, v in zip(out_ids, values)}
+        packed = [(oid, _pack_value(v)) for oid, v in zip(out_ids, values)]
         status, error = "FINISHED", None
     except BaseException as e:  # noqa: BLE001 - worker must survive user errors
         tb = traceback.format_exc()
         from ray_tpu.core.exceptions import TaskError
 
         err = TaskError(f"task {t.get('name') or task_id} failed: {e!r}", tb)
-        payloads = {oid: _pack_value(err, is_exception=True) for oid in out_ids}
+        packed = [(oid, _pack_value(err, is_exception=True)) for oid in out_ids]
         status, error = "FAILED", f"{e!r}"
-    client.call("task_finished", {
-        "task_id": task_id,
-        "status": status,
-        "error": error,
-        "result_payloads": payloads,
-        "start": start,
-        "end": time.time(),
-    }, timeout=120.0)
+    # Results go straight into shm (create+seal, zero daemon copies); the
+    # RPC carries only (oid, size). Fallback: bytes in the RPC frame.
+    try:
+        payloads, shm_results = {}, []
+        for oid, data in packed:
+            if _shm is not None and _shm.put_with_make_room(oid, data, client):
+                shm_results.append((oid, len(data)))
+            else:
+                payloads[oid] = data
+        client.call("task_finished", {
+            "task_id": task_id,
+            "status": status,
+            "error": error,
+            "result_payloads": payloads,
+            "result_shm": shm_results,
+            "start": start,
+            "end": time.time(),
+        }, timeout=120.0)
+    finally:
+        # leaked pins would make the objects permanently unevictable
+        for oid in pins:
+            try:
+                _shm.release(oid)
+            except Exception:  # noqa: BLE001
+                pass
 
 
 def main():  # pragma: no cover - runs as a subprocess
@@ -98,6 +151,7 @@ def main():  # pragma: no cover - runs as a subprocess
     port = int(os.environ["RAY_TPU_DAEMON_PORT"])
     worker_id = os.environ["RAY_TPU_WORKER_ID"]
     client = RpcClient(host, port, timeout=120.0)
+    _attach_shm()
     tasks: "queue.Queue[dict]" = queue.Queue()
     client.subscribe("run_task", tasks.put)
     client.on_close = lambda: os._exit(0)  # daemon gone -> exit

@@ -46,6 +46,10 @@ def _get_lib():
         lib.rts_base.argtypes = [ctypes.c_int64]
         lib.rts_obj_create.restype = ctypes.c_int64
         lib.rts_obj_create.argtypes = [ctypes.c_int64, ctypes.c_char_p, ctypes.c_uint64]
+        lib.rts_obj_create2.restype = ctypes.c_int64
+        lib.rts_obj_create2.argtypes = [
+            ctypes.c_int64, ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int,
+        ]
         lib.rts_obj_seal.argtypes = [ctypes.c_int64, ctypes.c_char_p]
         lib.rts_obj_get.restype = ctypes.c_int64
         lib.rts_obj_get.argtypes = [
@@ -74,6 +78,11 @@ def _check_id(object_id: bytes) -> bytes:
     if len(object_id) != _ID_LEN:
         raise ValueError(f"object id must be {_ID_LEN} bytes, got {len(object_id)}")
     return object_id
+
+
+def unlink(name: str) -> None:
+    """Remove a (possibly stale) shm segment by name; ignores absence."""
+    _get_lib().rts_unlink(name.encode())
 
 
 class ObjectStore:
@@ -132,9 +141,14 @@ class ObjectStore:
         return self._name
 
     # ------------------------------------------------------------ object API
-    def create_buffer(self, object_id: bytes, size: int) -> memoryview:
-        """Allocate a writable buffer; must be sealed before it is readable."""
-        off = self._lib.rts_obj_create(self._h, _check_id(object_id), size)
+    def create_buffer(self, object_id: bytes, size: int,
+                      allow_evict: bool = True) -> memoryview:
+        """Allocate a writable buffer; must be sealed before it is readable.
+        allow_evict=False raises StoreFullError instead of silently LRU-
+        evicting, letting a spill-aware owner persist victims first."""
+        off = self._lib.rts_obj_create2(
+            self._h, _check_id(object_id), size, 1 if allow_evict else 0
+        )
         if off == -4:
             raise ObjectExistsError(object_id.hex())
         if off == -2:
@@ -150,9 +164,10 @@ class ObjectStore:
         if rc < 0:
             raise ValueError(f"seal failed (state): {rc}")
 
-    def put(self, object_id: bytes, payload: bytes) -> None:
+    def put(self, object_id: bytes, payload: bytes,
+            allow_evict: bool = True) -> None:
         """create + copy + seal in one call."""
-        buf = self.create_buffer(object_id, len(payload))
+        buf = self.create_buffer(object_id, len(payload), allow_evict)
         buf[:] = payload
         self.seal(object_id)
 
