@@ -108,6 +108,7 @@ class ClusterClient:
         self._actor_queues: Dict[str, Any] = {}
         self._daemon_conns: Dict[str, RpcClient] = {}
         self._shm_conns: Dict[str, Any] = {}  # node_id -> ShmClientStore|False
+        self._reconstructing: set = set()  # producer task_ids being re-run
         self._gcs_host, self._gcs_port = host, port
         self._closed = False
         self.gcs.subscribe("task_result", self._on_task_result)
@@ -197,11 +198,20 @@ class ClusterClient:
             "kwargs": spec.kwargs,
             "method_name": spec.method_name,
         })
+        deps = []
+        for a in list(spec.args) + list(spec.kwargs.values()):
+            if isinstance(a, ObjectRef):
+                deps.append({
+                    "id": a.id,
+                    # producing task, for owner-side lineage reconstruction
+                    "task": a.task_id or self._ref_index.get(a.id),
+                })
         return {
             "task_id": spec.task_id,
             "name": spec.name,
             "class_key": spec.scheduling_class(),
             "resources": dict(spec.resources),
+            "deps": deps,
             "spec_bytes": spec_bytes,
             "num_returns": spec.num_returns,
             "owner": self.worker_id,
@@ -376,6 +386,21 @@ class ClusterClient:
         status = p.get("status")
         with self._lock:
             meta = self._task_meta.get(task_id)
+        with self._lock:
+            self._reconstructing.discard(task_id)
+        if status in ("DEPS_LOST", "DEPS_UNAVAILABLE") and meta is not None:
+            # lineage repair runs on its own thread (blocking GCS calls are
+            # forbidden on this reader thread), then resubmits the consumer
+            if meta.get("retries_left", 0) > 0:
+                meta["retries_left"] -= 1
+                lost = p.get("lost") or list(meta.get("deps") or ())
+                threading.Thread(
+                    target=self._repair_and_resubmit,
+                    args=(meta, lost), daemon=True,
+                    name=f"lineage-repair-{task_id[:8]}",
+                ).start()
+                return
+            status = "NODE_DIED"  # budget exhausted: fall into fail path
         if status in ("NODE_DIED", "WORKER_DIED") and meta is not None:
             if meta.get("retries_left", 0) > 0:
                 meta["retries_left"] -= 1
@@ -387,19 +412,93 @@ class ClusterClient:
                     return
                 except Exception:
                     pass
-            refs = [
-                ObjectRef.for_task_output(task_id, i, owner=self.worker_id)
-                for i in range(meta.get("num_returns", 1))
-            ]
-            err = TaskError(f"task failed after retries: {p.get('error')}")
-            for r in refs:
-                self.store.put(r, err, is_exception=True)
+            self._fail_task_refs(task_id, meta, p.get("error"))
             return
         refs = [
             ObjectRef.for_task_output(task_id, i, owner=self.worker_id)
             for i in range(meta.get("num_returns", 1) if meta else len(p.get("results", [])) or 1)
         ]
         self._ingest_result(p, refs)
+
+    def _fail_task_refs(self, task_id: str, meta: dict, error) -> None:
+        refs = [
+            ObjectRef.for_task_output(task_id, i, owner=self.worker_id)
+            for i in range(meta.get("num_returns", 1))
+        ]
+        err = TaskError(f"task failed after retries: {error}")
+        for r in refs:
+            self.store.put(r, err, is_exception=True)
+        # publish the error as the objects themselves so tasks waiting on
+        # these outputs fail with it instead of hanging at the dependency
+        # gate (reference: the owner stores the error object)
+        self._publish_error(refs, err)
+
+    def _repair_and_resubmit(self, meta: dict, lost_deps: List[dict]) -> None:
+        """Owner-driven lineage repair (reference: object_recovery_manager.cc
+        + lineage pinning): for each dep with no surviving copy, resubmit
+        its producing task (deduped) or republish a locally-cached put()
+        value; unrecoverable deps fail the consumer. Finally resubmits the
+        consumer, which the GCS dep-gate holds until the args exist."""
+        try:
+            for d in lost_deps:
+                oid = d["id"]
+                try:
+                    loc = self.gcs.call("locate_object", {"object_id": oid})
+                except Exception:  # noqa: BLE001
+                    loc = {}
+                if loc.get("nodes"):
+                    continue  # a copy survives; nothing to repair
+                # cheapest repair: republish a locally-cached value (inlined
+                # small results, put() values) instead of recomputing
+                entry = self.store.try_get(ObjectRef(oid))
+                if entry is not None and not entry.is_exception and not (
+                    isinstance(entry.value, tuple)
+                    and len(entry.value) == 2
+                    and entry.value[0] == "__remote__"
+                ):
+                    payload = serialization.pack({"e": False, "v": entry.value})
+                    node = self._pick_put_node()
+                    if node is not None:
+                        daemon = self._daemon(
+                            node["node_id"], node["addr"], node["port"]
+                        )
+                        daemon.call(
+                            "put_object", {"object_id": oid, "payload": payload}
+                        )
+                        continue
+                # lineage: resubmit the producing task (deduped)
+                ptid = d.get("task")
+                with self._lock:
+                    pmeta = self._task_meta.get(ptid) if ptid else None
+                if pmeta is not None:
+                    with self._lock:
+                        if ptid in self._reconstructing:
+                            continue  # another consumer already resubmitted
+                        self._reconstructing.add(ptid)
+                    self.gcs.call("submit_task", pmeta)
+                    continue
+                self._fail_task_refs(
+                    meta["task_id"], meta,
+                    f"arg object {oid[:8]} lost and not reconstructable",
+                )
+                return
+            self.gcs.call("submit_task", meta)
+        except Exception as e:  # noqa: BLE001
+            self._fail_task_refs(meta["task_id"], meta, f"lineage repair: {e!r}")
+
+    def _publish_error(self, refs: List[ObjectRef], err: BaseException) -> None:
+        """Write an exception payload into the cluster store under each
+        ref's id, so dependents waiting on them unblock and raise."""
+        payload = serialization.pack({"e": True, "v": err})
+        node = self._pick_put_node()
+        if node is None:
+            return
+        try:
+            daemon = self._daemon(node["node_id"], node["addr"], node["port"])
+            for r in refs:
+                daemon.call("put_object", {"object_id": r.id, "payload": payload})
+        except Exception:  # noqa: BLE001
+            pass
 
     def _ingest_result(self, p: dict, refs: List[ObjectRef]):
         inline = p.get("inline", {})

@@ -59,7 +59,15 @@ class GcsServer:
         # --- scheduler state ---
         self.pending: deque = deque()  # (spec_meta dict)
         self.running: Dict[str, dict] = {}  # task_id -> {node_id, demand, owner_conn}
-        self.actors_pending_node: Dict[str, str] = {}
+        # dependency gating (reference: dependency_manager.cc — a task is
+        # dispatched only once its args exist; waiting tasks hold NO
+        # resources and NO worker)
+        self.waiting_tasks: Dict[str, dict] = {}  # task_id -> {meta, missing}
+        self.dep_waiters: Dict[str, set] = defaultdict(set)  # oid -> task_ids
+        # incremental index: output object id -> number of queued/running
+        # tasks that will produce it (answers "will this dep ever appear?"
+        # in O(1) instead of scanning every queue)
+        self.active_outputs: Dict[str, int] = defaultdict(int)
 
         self.server = RpcServer(
             self._handle, host=host, port=port,
@@ -210,6 +218,8 @@ class GcsServer:
                     a["state"] = "ALIVE"
             for oid in p.get("object_ids", []):
                 self.directory[oid].add(node_id)
+                self._on_object_added(oid)
+        self._kick()
         return {"ok": True}
 
     def rpc_heartbeat(self, p, conn):
@@ -242,6 +252,12 @@ class GcsServer:
         """p: task meta {task_id, class_key, resources, spec_bytes, owner,
         actor_id?, actor_creation?, num_returns, strategy}."""
         with self._lock:
+            tid = p["task_id"]
+            if tid in self.running or tid in self.waiting_tasks:
+                # duplicate resubmission (e.g. two consumers reconstructing
+                # one producer): running it twice would leak the first
+                # dispatch's resource hold when the second overwrites it
+                return {"ok": True, "duplicate": True}
             p["owner_conn"] = conn.conn_id
             p["enqueued_at"] = time.time()
             if p.get("actor_creation"):
@@ -250,15 +266,107 @@ class GcsServer:
                 a = self.actors.get(p.get("actor_id"))
                 if a is not None:
                     a["creation_meta"] = dict(p)
-            self.pending.append(p)
+            missing = self._missing_deps(p)
+            dead = [
+                d for d in (p.get("deps") or ())
+                if d["id"] in missing
+                and self.active_outputs.get(d["id"], 0) == 0
+            ]
+            if dead:
+                # no copy anywhere and nothing queued will produce it: hand
+                # straight back for owner-side lineage repair
+                target = self._driver_conn(conn.conn_id)
+            elif missing:
+                self._track_enter(p)
+                self._enqueue_waiting(p, missing)
+            else:
+                self._track_enter(p)
+                self.pending.append(p)
+        if dead:
+            if target is not None:
+                payload = {
+                    "task_id": p["task_id"], "status": "DEPS_LOST",
+                    "error": "lost arg objects: "
+                             + ",".join(d["id"][:8] for d in dead),
+                    "lost": dead,
+                }
+                self.server.call_soon(
+                    lambda t=target, pl=payload: __import__("asyncio").ensure_future(
+                        t.push("task_result", pl)
+                    )
+                )
+            return {"ok": False, "deps_lost": [d["id"] for d in dead]}
         self._kick()
         return {"ok": True}
+
+    # --------------------------------------------------- dependency gating
+
+    @staticmethod
+    def _outputs_of(meta: dict) -> List[str]:
+        from ray_tpu.core.object_ref import ObjectRef
+
+        tid = meta.get("task_id")
+        if not tid:
+            return []
+        return [
+            ObjectRef.for_task_output(tid, i).id
+            for i in range(int(meta.get("num_returns", 1) or 1))
+        ]
+
+    def _track_enter(self, meta: dict) -> None:
+        """A task entered the system (pending/waiting). Caller holds _lock."""
+        for oid in self._outputs_of(meta):
+            self.active_outputs[oid] += 1
+
+    def _track_exit(self, meta: dict) -> None:
+        """A task left the system (done/failed/dropped). Caller holds _lock."""
+        for oid in self._outputs_of(meta):
+            n = self.active_outputs.get(oid)
+            if n is not None:
+                if n <= 1:
+                    del self.active_outputs[oid]
+                else:
+                    self.active_outputs[oid] = n - 1
+
+    def _missing_deps(self, t: dict) -> List[str]:
+        """Dep object ids with no live location yet. Caller holds _lock."""
+        out = []
+        for d in t.get("deps") or ():
+            oid = d["id"]
+            if not any(
+                self.nodes.get(nid, {}).get("alive")
+                for nid in self.directory.get(oid, ())
+            ):
+                out.append(oid)
+        return out
+
+    def _enqueue_waiting(self, t: dict, missing: List[str]) -> None:
+        self.waiting_tasks[t["task_id"]] = {"meta": t, "missing": set(missing)}
+        for oid in missing:
+            self.dep_waiters[oid].add(t["task_id"])
+
+    def _on_object_added(self, oid: str) -> bool:
+        """Move tasks whose last missing dep just appeared to the pending
+        queue. Caller holds _lock; returns True if anything became ready."""
+        ready = False
+        for tid in self.dep_waiters.pop(oid, ()):
+            w = self.waiting_tasks.get(tid)
+            if w is None:
+                continue
+            w["missing"].discard(oid)
+            if not w["missing"]:
+                del self.waiting_tasks[tid]
+                self.pending.append(w["meta"])
+                ready = True
+        return ready
 
     def rpc_task_done(self, p, conn):
         """From a node daemon: task finished. p: {task_id, node_id, status,
         results: [(oid, size)], inline: {oid: bytes}, error?, actor_id?}"""
         with self._lock:
             info = self.running.pop(p["task_id"], None)
+            if info is not None:
+                self._track_exit(info.get("meta", {}))
             if info is not None:
                 if p.get("actor_creation") and p.get("status") == "FINISHED":
                     # alive actors hold their allocation for their lifetime
@@ -270,6 +378,7 @@ class GcsServer:
                         self.state.release(idx, info["demand"])
             for oid, size in p.get("results", []):
                 self.directory[oid].add(p["node_id"])
+                self._on_object_added(oid)
             self.task_events.append(
                 {k: p.get(k) for k in ("task_id", "node_id", "status", "name",
                                        "start", "end", "actor_id")}
@@ -297,8 +406,14 @@ class GcsServer:
                             alive_actor = p["actor_id"]
                     elif a["state"] == "STARTING":
                         # failed creation; a concurrent actor_died may have
-                        # queued a restart (RESTARTING) — don't clobber it
-                        a["state"] = "DEAD"
+                        # queued a restart (RESTARTING) — don't clobber it.
+                        # Retryable failures go back to PENDING so the
+                        # owner's resubmission isn't dropped as "killed".
+                        retryable = p.get("status") in (
+                            "WORKER_DIED", "NODE_DIED", "DEPS_UNAVAILABLE",
+                        ) and info is not None and \
+                            info.get("meta", {}).get("retries_left", 0) > 0
+                        a["state"] = "PENDING" if retryable else "DEAD"
             target = self._driver_conn(owner_conn)
         if kill_on_node is not None:
             self._push_to_node(
@@ -327,6 +442,9 @@ class GcsServer:
     def rpc_add_object_location(self, p, conn):
         with self._lock:
             self.directory[p["object_id"]].add(p["node_id"])
+            ready = self._on_object_added(p["object_id"])
+        if ready:
+            self._kick()
         return {"ok": True}
 
     def rpc_locate_object(self, p, conn):
@@ -421,7 +539,9 @@ class GcsServer:
         a["restarts"] = a.get("restarts", 0) + 1
         a["state"] = "RESTARTING"
         a["node_id"] = None
-        self.pending.append(dict(meta))
+        meta = dict(meta)
+        self._track_enter(meta)
+        self.pending.append(meta)
         return True
 
     def rpc_kill_actor(self, p, conn):
@@ -505,7 +625,7 @@ class GcsServer:
             return {
                 "nodes_alive": sum(1 for n in self.nodes.values() if n["alive"]),
                 "nodes_dead": sum(1 for n in self.nodes.values() if not n["alive"]),
-                "tasks_pending": len(self.pending),
+                "tasks_pending": len(self.pending) + len(self.waiting_tasks),
                 "tasks_running": len(self.running),
                 "actors": len(self.actors),
                 "placement_groups": len(self.placement_groups),
@@ -627,11 +747,35 @@ class GcsServer:
 
             # split off strategy-constrained tasks (node affinity / PG bundle)
             default_batch, special = [], []
+            seen_ids = set()
+            deps_lost_round: List[tuple] = []
             for t in batch:
+                tid = t["task_id"]
+                if tid in seen_ids or tid in self.running:
+                    self._track_exit(t)
+                    continue  # duplicate submission: never run twice
+                seen_ids.add(tid)
                 if t.get("actor_creation"):
                     a = self.actors.get(t.get("actor_id"))
                     if a is not None and a["state"] == "DEAD":
+                        self._track_exit(t)
                         continue  # killed while pending/restarting: drop
+                missing = self._missing_deps(t)
+                if missing:
+                    # a dep location vanished after submit (node death). If
+                    # a producer will still create it, wait; otherwise hand
+                    # the task back to its owner for lineage repair
+                    dead_deps = [
+                        d for d in (t.get("deps") or ())
+                        if d["id"] in missing
+                        and self.active_outputs.get(d["id"], 0) == 0
+                    ]
+                    if dead_deps:
+                        self._track_exit(t)
+                        deps_lost_round.append((t, dead_deps))
+                    else:
+                        self._enqueue_waiting(t, missing)
+                    continue
                 if t.get("strategy", {}).get("kind") in ("NODE_AFFINITY", "PLACEMENT_GROUP"):
                     special.append(t)
                 else:
@@ -668,6 +812,7 @@ class GcsServer:
                 if kind == "dispatch":
                     dispatches.append(payload)
                 elif kind == "fail":
+                    self._track_exit(t)
                     failed.append((t, payload))
                 else:
                     leftovers.append(t)
@@ -701,6 +846,20 @@ class GcsServer:
             if target is not None:
                 payload = {"task_id": t["task_id"], "status": "UNSCHEDULABLE",
                            "error": reason}
+                self.server.call_soon(
+                    lambda tg=target, pl=payload: __import__("asyncio").ensure_future(
+                        tg.push("task_result", pl)
+                    )
+                )
+        for t, lost in deps_lost_round:
+            target = self._driver_conn(t.get("owner_conn"))
+            if target is not None:
+                payload = {
+                    "task_id": t["task_id"], "status": "DEPS_LOST",
+                    "error": "lost arg objects: "
+                             + ",".join(d["id"][:8] for d in lost),
+                    "lost": lost,
+                }
                 self.server.call_soon(
                     lambda tg=target, pl=payload: __import__("asyncio").ensure_future(
                         tg.push("task_result", pl)
@@ -833,11 +992,43 @@ class GcsServer:
                 (tid, info) for tid, info in self.running.items()
                 if info["node_id"] == node_id
             ]
-            for tid, _ in lost_tasks:
+            for tid, info in lost_tasks:
                 self.running.pop(tid, None)
+                if not tid.startswith("actor-hold-"):
+                    self._track_exit(info.get("meta", {}))
             # objects on the node are gone from the directory
             for oid, nodes in list(self.directory.items()):
                 nodes.discard(node_id)
+            # waiting tasks whose deps lost their LAST copy with no active
+            # producer can never become ready — hand them back to their
+            # owners, who reconstruct the producers (lineage, reference:
+            # object_recovery_manager.cc driven from the owner)
+            # outputs of retryable just-lost tasks will reappear once their
+            # owners resubmit — don't declare them dead yet
+            will_return: set = set()
+            for _tid, info in lost_tasks:
+                m = info.get("meta", {})
+                if m.get("retries_left", 0) > 0:
+                    will_return.update(self._outputs_of(m))
+            deps_lost: List[tuple] = []  # (meta, [lost dep dicts])
+            for tid, w in list(self.waiting_tasks.items()):
+                # check EVERY dep: a previously-satisfied one may have just
+                # lost its only copy too
+                lost = [
+                    d for d in (w["meta"].get("deps") or ())
+                    if self.active_outputs.get(d["id"], 0) == 0
+                    and d["id"] not in will_return
+                    and not any(
+                        self.nodes.get(nid, {}).get("alive")
+                        for nid in self.directory.get(d["id"], ())
+                    )
+                ]
+                if lost:
+                    del self.waiting_tasks[tid]
+                    self._track_exit(w["meta"])
+                    for oid in w["missing"]:
+                        self.dep_waiters.get(oid, set()).discard(tid)
+                    deps_lost.append((w["meta"], lost))
             dead_actors = [
                 a for a in self.actors.values()
                 if a["node_id"] == node_id and a["state"] in ("ALIVE", "STARTING")
@@ -867,6 +1058,20 @@ class GcsServer:
                 payload = {
                     "task_id": tid, "status": "NODE_DIED", "node_id": node_id,
                     "error": f"node {node_id} died: {cause}",
+                }
+                self.server.call_soon(
+                    lambda t=target, pl=payload: __import__("asyncio").ensure_future(
+                        t.push("task_result", pl)
+                    )
+                )
+        for meta, lost in deps_lost:
+            target = self._driver_conn(meta.get("owner_conn"))
+            if target is not None:
+                payload = {
+                    "task_id": meta["task_id"], "status": "DEPS_LOST",
+                    "error": "lost arg objects: "
+                             + ",".join(d["id"][:8] for d in lost),
+                    "lost": lost,
                 }
                 self.server.call_soon(
                     lambda t=target, pl=payload: __import__("asyncio").ensure_future(

@@ -196,6 +196,8 @@ class NodeDaemon:
         )
         self.port = self.server.start()
 
+        # daemon threads (never block process exit), bounded by semaphore
+        self._prefetch_sem = threading.Semaphore(4)
         self._gcs_addr = gcs_addr
         self._labels = dict(labels or {})
         self._nodes_snapshot: Dict[str, dict] = {}
@@ -457,6 +459,42 @@ class NodeDaemon:
     # --------------------------------------------------------- task dispatch
 
     def _on_exec_task(self, t: dict):
+        missing = [
+            d["id"] for d in t.get("deps") or ()
+            if not self.store.contains(d["id"])
+        ]
+        if missing:
+            # pull args into the local store FIRST; the task reaches a
+            # worker only with args local, so workers never block holding
+            # their slot (reference: local_task_manager.cc dispatches only
+            # when DependencyManager reports args local)
+            threading.Thread(
+                target=self._prefetch_then_queue, args=(t, missing),
+                daemon=True, name="daemon-prefetch",
+            ).start()
+            return
+        with self._lock:
+            self._task_queue.append(t)
+        self._pump()
+
+    def _prefetch_then_queue(self, t: dict, missing: List[str]):
+        with self._prefetch_sem:
+            for oid in missing:
+                if self._stopped:
+                    return
+                if self._get_object_bytes(
+                    oid, timeout=self.config.object_fetch_timeout_s
+                ) is None:
+                    self._report_done(
+                        t, status="DEPS_UNAVAILABLE",
+                        error=f"arg object {oid[:8]} unavailable on "
+                              f"{self.node_id}",
+                        lost=[d for d in t.get("deps") or ()
+                              if d["id"] == oid],
+                    )
+                    return
+        if self._stopped:
+            return
         with self._lock:
             self._task_queue.append(t)
         self._pump()
@@ -523,10 +561,11 @@ class NodeDaemon:
         )
 
     def _report_done(self, t: dict, status: str, error=None, results=None,
-                     start=None, end=None):
+                     start=None, end=None, lost=None):
         task_id = t["task_id"]
         fut = self._pending_rpc.pop(task_id, None)
         payload = {
+            "lost": lost or [],
             "task_id": task_id,
             "node_id": self.node_id,
             "status": status,
@@ -573,7 +612,7 @@ class NodeDaemon:
         if payload is not None:
             return payload
         deadline = time.time() + timeout
-        while time.time() < deadline:
+        while time.time() < deadline and not self._stopped:
             try:
                 loc = self.gcs.call("locate_object", {"object_id": oid})
             except Exception:

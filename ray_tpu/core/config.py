@@ -28,6 +28,10 @@ _DEFS: Dict[str, tuple] = {
     "object_store_memory_bytes": (int, 256 * 1024 * 1024),
     "object_spilling_dir": (str, ""),  # empty -> <session_dir>/spill
     "object_transfer_chunk_bytes": (int, 1024 * 1024),
+    # daemon-side arg prefetch bound; short on purpose — on failure the task
+    # returns to the GCS dependency gate, which holds it until the object
+    # actually exists (so slow producers don't need a long timeout here)
+    "object_fetch_timeout_s": (float, 10.0),
     "memory_monitor_interval_ms": (float, 500.0),
     "gcs_port": (int, 0),  # 0 -> pick free port
     # daemons/drivers retry re-connecting to a restarted GCS for this long

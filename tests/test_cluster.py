@@ -195,3 +195,55 @@ def test_cluster_timeline_and_summary(cluster):
     ray_tpu.get(traced.remote(), timeout=60)
     events = ray_tpu.timeline()
     assert any(e.get("name") == "traced" for e in events)
+
+
+def test_dependency_aware_dispatch_holds_no_resources():
+    """Tasks with unmet deps wait at the GCS holding neither a worker nor
+    resources; dependency chains longer than worker count complete
+    (reference: dependency_manager.cc + local_task_manager.cc dispatch-
+    only-when-args-local)."""
+    import ray_tpu
+    from ray_tpu.cluster.cluster_utils import Cluster
+
+    cluster = Cluster()
+    cluster.add_node(num_cpus=1)  # one slot: waiting consumers would deadlock it
+    ray_tpu.init(address=cluster.address)
+    try:
+        @ray_tpu.remote
+        def src():
+            import time as _t
+            _t.sleep(0.8)
+            return 7
+
+        @ray_tpu.remote
+        def plus(x, y):
+            return x + y
+
+        s = src.remote()
+        consumers = [plus.remote(s, i) for i in range(6)]
+        import time as _t
+        _t.sleep(0.4)  # src still sleeping on the only CPU
+        gcs = cluster.gcs
+        with gcs._lock:
+            waiting = len(gcs.waiting_tasks)
+            avail_cpu = gcs.state.available_map().get(
+                gcs.state.node_ids[0], {}).get("CPU", 0.0)
+        # all consumers parked at the dep gate; only src holds the CPU
+        assert waiting == 6, f"expected 6 waiting, got {waiting}"
+        assert avail_cpu == 0.0
+        out = ray_tpu.get(consumers, timeout=30.0)
+        assert out == [7 + i for i in range(6)]
+
+        # a chain much longer than the worker pool also completes
+        r = ray_tpu.put(0)
+
+        @ray_tpu.remote
+        def inc(x):
+            return x + 1
+
+        for _ in range(25):
+            r = inc.remote(r)
+        assert ray_tpu.get(r, timeout=60.0) == 25
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()

@@ -219,3 +219,34 @@ def test_actor_no_restart_when_budget_exhausted():
     finally:
         ray_tpu.shutdown()
         cluster.shutdown()
+
+
+def test_lineage_reconstruction_of_lost_dep():
+    """An arg object whose only copy died with its node is reconstructed by
+    resubmitting its producing task (owner-driven lineage, reference:
+    object_recovery_manager.cc + reference_count.cc lineage pinning)."""
+    cluster = Cluster()
+    cluster.add_node(num_cpus=2)
+    victim = cluster.add_node(num_cpus=2, resources={"victim": 1})
+    ray_tpu.init(address=cluster.address)
+    try:
+        @ray_tpu.remote(resources={"victim": 0.001})
+        def produce():
+            return np.arange(1000)
+
+        @ray_tpu.remote
+        def consume(x):
+            return int(x.sum())
+
+        src = produce.remote()
+        ray_tpu.wait([src], timeout=15.0)  # produced on the victim
+        cluster.kill_node(victim)
+        # reconstruction needs somewhere with the "victim" resource to rerun
+        cluster.add_node(num_cpus=2, resources={"victim": 1})
+        time.sleep(0.5)
+        # the consumer's dep has no live copy; the driver must reconstruct
+        out = ray_tpu.get(consume.remote(src), timeout=40.0)
+        assert out == sum(range(1000))
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
