@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import threading
 import time
-from collections import defaultdict
+from collections import defaultdict, deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -109,6 +109,20 @@ class ClusterClient:
         self._daemon_conns: Dict[str, RpcClient] = {}
         self._shm_conns: Dict[str, Any] = {}  # node_id -> ShmClientStore|False
         self._reconstructing: set = set()  # producer task_ids being re-run
+        # ---- distributed reference counting (owner side) ----
+        # Semantics from reference_count.cc (owned refs, task-duration arg
+        # pins, lineage pinned while outputs live), not its implementation:
+        # counting is owner-local; borrowers are kept alive by the owner's
+        # in-flight pin for the duration of the borrowing task. v1 gap:
+        # long-lived borrows (a worker stashing a ref past its task) are
+        # not tracked.
+        self._refcounts: Dict[str, list] = {}  # oid -> [local, pinned]
+        self._task_pins: Dict[str, list] = {}  # task_id -> pinned oids
+        self._task_outputs: Dict[str, set] = {}  # task_id -> live output oids
+        self._task_out_ids: Dict[str, list] = {}  # task_id -> all output oids
+        self._task_dep_ids: Dict[str, list] = {}  # task_id -> dep oids
+        self._lineage_consumers: Dict[str, set] = {}  # dep oid -> consumer tids
+        self._gc_queue: deque = deque()
         self._gcs_host, self._gcs_port = host, port
         self._closed = False
         self.gcs.subscribe("task_result", self._on_task_result)
@@ -118,6 +132,129 @@ class ClusterClient:
         reply = self.gcs.call("register_driver", {"driver_id": self.worker_id})
         self._nodes: Dict[str, dict] = reply["nodes"]
         self._put_rr = 0
+        self._gc_thread = threading.Thread(
+            target=self._gc_loop, daemon=True, name="driver-gc"
+        )
+        self._gc_thread.start()
+
+    # ------------------------------------------------- reference counting
+
+    def _register_ref(self, ref: ObjectRef) -> None:
+        """Count a user-facing owned ref instance."""
+        with self._lock:
+            if ref._register(self._on_ref_del):
+                self._refcounts.setdefault(ref.id, [0, 0])[0] += 1
+
+    def _pin(self, oid: str, n: int = 1) -> None:
+        """In-flight pin: arg of a submitted task / output of a pending
+        task. Caller holds _lock."""
+        self._refcounts.setdefault(oid, [0, 0])[1] += n
+
+    def _unpin(self, oid: str) -> None:
+        with self._lock:
+            rc = self._refcounts.get(oid)
+            if rc is None:
+                return
+            rc[1] -= 1
+            free = rc[0] <= 0 and rc[1] <= 0
+        if free:
+            self._queue_free(oid)
+
+    def _on_ref_del(self, oid: str) -> None:
+        # Runs from __del__, possibly inside a cyclic-GC pass triggered
+        # while THIS thread already holds self._lock — so it must stay
+        # lock-free: deque.append is atomic; the GC thread applies the
+        # decrement under the lock.
+        if not self._closed:
+            self._gc_queue.append(("decref", oid))
+
+    def _queue_free(self, oid: str) -> None:
+        self._gc_queue.append(("check", oid))
+
+    def _release_task_deps(self, task_id: str) -> None:
+        """Terminal task result: release its arg + output pins (idempotent —
+        the pin list is popped exactly once). Actor calls additionally shed
+        their lineage-consumer edges here: they are never reconstructed, so
+        they must not pin their dep producers' specs past completion."""
+        pins = self._task_pins.pop(task_id, None)
+        for oid in pins or ():
+            self._unpin(oid)
+        if pins is not None:
+            with self._lock:
+                if task_id not in self._task_meta:
+                    for d in self._task_dep_ids.pop(task_id, ()):
+                        self._drop_consumer_edge(d, task_id)
+
+    def _maybe_drop_lineage(self, tid: str) -> None:
+        """Drop a task's spec when no live output remains AND no retained
+        consumer lineage could still need its outputs reconstructed
+        (transitive lineage pinning, reference: reference_count.cc keeping
+        lineage while reconstructable refs exist). Cascades to producers
+        whose last consumer was just dropped. Caller holds _lock."""
+        if self._task_outputs.get(tid):
+            return  # an output ref is still live
+        out_ids = self._task_out_ids.get(tid, ())
+        if any(self._lineage_consumers.get(o) for o in out_ids):
+            return  # a consumer may reconstruct through these outputs
+        self._task_meta.pop(tid, None)
+        self._task_outputs.pop(tid, None)
+        self._task_pins.pop(tid, None)
+        for o in self._task_out_ids.pop(tid, ()):
+            self._ref_index.pop(o, None)
+        for d in self._task_dep_ids.pop(tid, ()):
+            self._drop_consumer_edge(d, tid)
+
+    def _drop_consumer_edge(self, dep_oid: str, tid: str) -> None:
+        """Remove tid from dep_oid's consumer set; if it was the last
+        consumer and the object itself is already freed, the dep's producer
+        may now be droppable too (cascade). Caller holds _lock."""
+        cons = self._lineage_consumers.get(dep_oid)
+        if cons is None:
+            return
+        cons.discard(tid)
+        if not cons:
+            del self._lineage_consumers[dep_oid]
+            if dep_oid not in self._refcounts:  # object already freed
+                ptid = self._ref_index.get(dep_oid)
+                if ptid is not None:
+                    self._maybe_drop_lineage(ptid)
+
+    def _gc_loop(self) -> None:
+        """Batched auto-free (reference: the eviction pubsub that follows
+        UpdateFinishedTaskReferences; batched here to amortize the RPC)."""
+        while not self._closed:
+            time.sleep(0.1)
+            batch = []
+            while self._gc_queue:
+                batch.append(self._gc_queue.popleft())
+            if not batch:
+                continue
+            drop = []
+            with self._lock:
+                for kind, oid in batch:
+                    rc = self._refcounts.get(oid)
+                    if rc is None:
+                        continue
+                    if kind == "decref":
+                        rc[0] -= 1
+                    if rc[0] > 0 or rc[1] > 0:
+                        continue  # still referenced / pinned
+                    self._refcounts.pop(oid, None)
+                    self._result_ready.pop(oid, None)
+                    drop.append(oid)
+                    tid = self._ref_index.get(oid)
+                    if tid is not None:
+                        outs = self._task_outputs.get(tid)
+                        if outs is not None:
+                            outs.discard(oid)
+                        self._maybe_drop_lineage(tid)
+            if not drop:
+                continue
+            self.store.delete([ObjectRef(oid) for oid in drop])
+            try:
+                self.gcs.call("free_objects", {"object_ids": drop})
+            except Exception:  # noqa: BLE001
+                pass
 
     # -------------------------------------------------- GCS reconnection
 
@@ -174,7 +311,9 @@ class ClusterClient:
             for i in range(spec.num_returns)
         ]
         if spec.actor_id is not None and not spec.actor_creation:
-            self._submit_actor_call(spec, refs)
+            meta = self._make_meta(spec)
+            self._track_submission(spec.task_id, meta, refs)
+            self._submit_actor_call_meta(spec.actor_id, meta, refs)
             return refs
         meta = self._make_meta(spec)
         if spec.actor_creation:
@@ -186,10 +325,27 @@ class ClusterClient:
             })
         with self._lock:
             self._task_meta[spec.task_id] = meta
-            for r in refs:
-                self._ref_index[r.id] = spec.task_id
+        self._track_submission(spec.task_id, meta, refs)
         self.gcs.call("submit_task", meta)
         return refs
+
+    def _track_submission(self, task_id: str, meta: dict,
+                          refs: List[ObjectRef]) -> None:
+        """Refcount bookkeeping at submit: args pinned for the task's
+        flight, outputs pinned until the result lands, lineage indexed."""
+        pins = [d["id"] for d in meta.get("deps", ())] + [r.id for r in refs]
+        with self._lock:
+            self._ref_index.update({r.id: task_id for r in refs})
+            self._task_outputs[task_id] = {r.id for r in refs}
+            self._task_out_ids[task_id] = [r.id for r in refs]
+            self._task_dep_ids[task_id] = [d["id"] for d in meta.get("deps", ())]
+            self._task_pins[task_id] = pins
+            for d in meta.get("deps", ()):
+                self._lineage_consumers.setdefault(d["id"], set()).add(task_id)
+            for oid in pins:
+                self._pin(oid)
+        for r in refs:
+            self._register_ref(r)
 
     def _make_meta(self, spec: TaskSpec) -> dict:
         spec_bytes = serialization.dumps({
@@ -230,23 +386,23 @@ class ClusterClient:
 
     # ------------------------------------------------------------ actor path
 
-    def _submit_actor_call(self, spec: TaskSpec, refs: List[ObjectRef]):
+    def _submit_actor_call_meta(self, actor_id: str, meta: dict,
+                                refs: List[ObjectRef]):
         """Ordered actor submission: one dispatcher thread per actor sends
         calls in submit order on one connection — frame order IS execution
         order at the actor (reference: actor_task_submitter.cc +
         actor_submit_queue.h sequence numbers). Responses resolve
         concurrently via future callbacks."""
-        meta = self._make_meta(spec)
         with self._lock:
-            q = self._actor_queues.get(spec.actor_id)
+            q = self._actor_queues.get(actor_id)
             if q is None:
                 q = _ActorQueue()
-                self._actor_queues[spec.actor_id] = q
+                self._actor_queues[actor_id] = q
                 t = threading.Thread(
                     target=self._actor_dispatch_loop,
-                    args=(spec.actor_id, q),
+                    args=(actor_id, q),
                     daemon=True,
-                    name=f"actor-dispatch-{spec.actor_id[:8]}",
+                    name=f"actor-dispatch-{actor_id[:8]}",
                 )
                 t.start()
         q.put(meta, refs)
@@ -272,9 +428,10 @@ class ClusterClient:
                 return
             seq, (meta, refs) = got
 
-            def fail(err, refs=refs):
+            def fail(err, refs=refs, meta=meta):
                 for r in refs:
                     self.store.put(r, err, is_exception=True)
+                self._release_task_deps(meta["task_id"])
 
             try:
                 info = self._actor_location(actor_id, wait=True, timeout=60)
@@ -309,6 +466,7 @@ class ClusterClient:
                             r, ActorDiedError(f"actor node unreachable: {e}"),
                             is_exception=True,
                         )
+                    self._release_task_deps(meta["task_id"])
                     return
                 except Exception as e:  # noqa: BLE001
                     for r in refs:
@@ -316,11 +474,13 @@ class ClusterClient:
                             r, TaskError(f"actor call failed: {e!r}"),
                             is_exception=True,
                         )
+                    self._release_task_deps(meta["task_id"])
                     return
                 if p.get("status") == "ACTOR_UNREACHABLE" and \
                         self._maybe_replay_actor_call(actor_id, seq, meta, refs):
                     return
                 self._ingest_result(p, refs)
+                self._release_task_deps(meta["task_id"])
 
             fut.add_done_callback(on_done)
 
@@ -419,6 +579,7 @@ class ClusterClient:
             for i in range(meta.get("num_returns", 1) if meta else len(p.get("results", [])) or 1)
         ]
         self._ingest_result(p, refs)
+        self._release_task_deps(task_id)
 
     def _fail_task_refs(self, task_id: str, meta: dict, error) -> None:
         refs = [
@@ -432,6 +593,7 @@ class ClusterClient:
         # these outputs fail with it instead of hanging at the dependency
         # gate (reference: the owner stores the error object)
         self._publish_error(refs, err)
+        self._release_task_deps(task_id)
 
     def _repair_and_resubmit(self, meta: dict, lost_deps: List[dict]) -> None:
         """Owner-driven lineage repair (reference: object_recovery_manager.cc
@@ -440,6 +602,7 @@ class ClusterClient:
         value; unrecoverable deps fail the consumer. Finally resubmits the
         consumer, which the GCS dep-gate holds until the args exist."""
         try:
+            all_present = True
             for d in lost_deps:
                 oid = d["id"]
                 try:
@@ -448,6 +611,7 @@ class ClusterClient:
                     loc = {}
                 if loc.get("nodes"):
                     continue  # a copy survives; nothing to repair
+                all_present = False
                 # cheapest repair: republish a locally-cached value (inlined
                 # small results, put() values) instead of recomputing
                 entry = self.store.try_get(ObjectRef(oid))
@@ -475,13 +639,24 @@ class ClusterClient:
                         if ptid in self._reconstructing:
                             continue  # another consumer already resubmitted
                         self._reconstructing.add(ptid)
-                    self.gcs.call("submit_task", pmeta)
+                    try:
+                        self.gcs.call("submit_task", pmeta)
+                    except Exception:
+                        # leave the door open for a later repair attempt
+                        with self._lock:
+                            self._reconstructing.discard(ptid)
+                        raise
                     continue
                 self._fail_task_refs(
                     meta["task_id"], meta,
                     f"arg object {oid[:8]} lost and not reconstructable",
                 )
                 return
+            if all_present and meta.get("_dep_refunds", 0) < 5:
+                # every "lost" dep actually exists: this was a slow
+                # transfer, not a failure — don't charge the retry budget
+                meta["_dep_refunds"] = meta.get("_dep_refunds", 0) + 1
+                meta["retries_left"] = meta.get("retries_left", 0) + 1
             self.gcs.call("submit_task", meta)
         except Exception as e:  # noqa: BLE001
             self._fail_task_refs(meta["task_id"], meta, f"lineage repair: {e!r}")
@@ -558,6 +733,7 @@ class ClusterClient:
             # no nodes yet: keep locally; remote workers can't fetch it, but
             # a clusterless driver can still get() it back
             self.store.put(ref, value)
+            self._register_ref(ref)
             return ref
         daemon = self._daemon(node["node_id"], node["addr"], node["port"])
         seg = self._local_shm(node["node_id"])
@@ -569,6 +745,7 @@ class ClusterClient:
         if not stored:
             daemon.call("put_object", {"object_id": ref.id, "payload": payload})
         self.store.put(ref, value)  # local cache
+        self._register_ref(ref)
         return ref
 
     def _pick_put_node(self):

@@ -275,7 +275,7 @@ class GcsServer:
             if dead:
                 # no copy anywhere and nothing queued will produce it: hand
                 # straight back for owner-side lineage repair
-                target = self._driver_conn(conn.conn_id)
+                pass
             elif missing:
                 self._track_enter(p)
                 self._enqueue_waiting(p, missing)
@@ -283,23 +283,34 @@ class GcsServer:
                 self._track_enter(p)
                 self.pending.append(p)
         if dead:
-            if target is not None:
-                payload = {
-                    "task_id": p["task_id"], "status": "DEPS_LOST",
-                    "error": "lost arg objects: "
-                             + ",".join(d["id"][:8] for d in dead),
-                    "lost": dead,
-                }
-                self.server.call_soon(
-                    lambda t=target, pl=payload: __import__("asyncio").ensure_future(
-                        t.push("task_result", pl)
-                    )
-                )
+            self._push_deps_lost(p, dead, conn_id=conn.conn_id)
             return {"ok": False, "deps_lost": [d["id"] for d in dead]}
         self._kick()
         return {"ok": True}
 
     # --------------------------------------------------- dependency gating
+
+    def _push_deps_lost(self, meta: dict, lost: List[dict],
+                        conn_id=None) -> None:
+        """Hand a task back to its owner for lineage repair. Call WITHOUT
+        holding _lock when possible (only reads drivers table briefly)."""
+        with self._lock:
+            target = self._driver_conn(
+                conn_id if conn_id is not None else meta.get("owner_conn")
+            )
+        if target is None:
+            return
+        payload = {
+            "task_id": meta["task_id"], "status": "DEPS_LOST",
+            "error": "lost arg objects: "
+                     + ",".join(d["id"][:8] for d in lost),
+            "lost": lost,
+        }
+        self.server.call_soon(
+            lambda t=target, pl=payload: __import__("asyncio").ensure_future(
+                t.push("task_result", pl)
+            )
+        )
 
     @staticmethod
     def _outputs_of(meta: dict) -> List[str]:
@@ -852,19 +863,7 @@ class GcsServer:
                     )
                 )
         for t, lost in deps_lost_round:
-            target = self._driver_conn(t.get("owner_conn"))
-            if target is not None:
-                payload = {
-                    "task_id": t["task_id"], "status": "DEPS_LOST",
-                    "error": "lost arg objects: "
-                             + ",".join(d["id"][:8] for d in lost),
-                    "lost": lost,
-                }
-                self.server.call_soon(
-                    lambda tg=target, pl=payload: __import__("asyncio").ensure_future(
-                        tg.push("task_result", pl)
-                    )
-                )
+            self._push_deps_lost(t, lost)
 
     def _schedule_special(self, t) -> Tuple[str, Any]:
         """NODE_AFFINITY and PLACEMENT_GROUP strategies (reference:
@@ -1065,19 +1064,7 @@ class GcsServer:
                     )
                 )
         for meta, lost in deps_lost:
-            target = self._driver_conn(meta.get("owner_conn"))
-            if target is not None:
-                payload = {
-                    "task_id": meta["task_id"], "status": "DEPS_LOST",
-                    "error": "lost arg objects: "
-                             + ",".join(d["id"][:8] for d in lost),
-                    "lost": lost,
-                }
-                self.server.call_soon(
-                    lambda t=target, pl=payload: __import__("asyncio").ensure_future(
-                        t.push("task_result", pl)
-                    )
-                )
+            self._push_deps_lost(meta, lost)
         for aid, state in actor_updates:
             self.server.broadcast(
                 "actor_update", {"actor_id": aid, "state": state}

@@ -18,7 +18,7 @@ def _rand_hex(n: int = 16) -> str:
 
 
 class ObjectRef:
-    __slots__ = ("id", "owner", "task_id", "_hash")
+    __slots__ = ("id", "owner", "task_id", "_hash", "_on_del")
 
     def __init__(self, id: Optional[str] = None, owner: Optional[str] = None,
                  task_id: Optional[str] = None):
@@ -26,6 +26,24 @@ class ObjectRef:
         self.owner = owner  # owner worker/driver id (ownership-based directory)
         self.task_id = task_id  # creating task, for lineage reconstruction
         self._hash = hash(self.id)
+
+    def _register(self, on_del) -> bool:
+        """Runtime hook: count this instance toward the owner's local
+        refcount; its deletion decrements (reference: reference_count.cc
+        AddLocalReference / the Cython __dealloc__ path). Returns False if
+        already registered (never double-count one instance)."""
+        if getattr(self, "_on_del", None) is not None:
+            return False
+        self._on_del = on_del
+        return True
+
+    def __del__(self):
+        cb = getattr(self, "_on_del", None)
+        if cb is not None:
+            try:
+                cb(self.id)
+            except Exception:  # noqa: BLE001 - interpreter teardown
+                pass
 
     @staticmethod
     def for_task_output(task_id: str, index: int, owner: Optional[str] = None) -> "ObjectRef":

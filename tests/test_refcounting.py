@@ -1,0 +1,118 @@
+"""Distributed reference counting: auto-free, bounded store, lineage
+pinning (reference: src/ray/core_worker/reference_count.cc semantics —
+owner-based counts, task-duration pins, lineage pinned while
+reconstructable refs exist — and python/ray/tests/test_reference_counting.py
+coverage style)."""
+
+import gc
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core.config import Config
+from ray_tpu.cluster.cluster_utils import Cluster
+
+
+def _wait(cond, timeout=10.0, msg=""):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise AssertionError(msg or "condition not met")
+
+
+def test_auto_free_on_ref_drop():
+    """Dropping the last ObjectRef frees the object cluster-wide without
+    any manual free()."""
+    cluster = Cluster()
+    cluster.add_node(num_cpus=2)
+    ray_tpu.init(address=cluster.address)
+    try:
+        daemon = cluster.daemons[0]
+        big = np.ones(200_000, dtype=np.float64)  # 1.6MB, too big to inline
+        ref = ray_tpu.put(big)
+        oid = ref.id
+        _wait(lambda: daemon.store.contains(oid), msg="put never landed")
+        del ref
+        gc.collect()
+        _wait(lambda: not daemon.store.contains(oid), timeout=10.0,
+              msg="object not auto-freed after ref drop")
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_store_bounded_under_churn_without_manual_free():
+    """Many tasks with large outputs, refs dropped as results are read:
+    the store (memory + spill) stays bounded — the VERDICT GC criterion."""
+    cfg = Config(overrides={"object_store_memory_bytes": 32 * 1024 * 1024})
+    cluster = Cluster(config=cfg)
+    cluster.add_node(num_cpus=2)
+    ray_tpu.init(address=cluster.address)
+    try:
+        @ray_tpu.remote
+        def blob(i):
+            return np.full(150_000, i, dtype=np.float64)  # 1.2MB
+
+        daemon = cluster.daemons[0]
+        total = 120  # 144MB through a 32MB store
+        for wave in range(0, total, 8):
+            refs = [blob.remote(i) for i in range(wave, wave + 8)]
+            outs = ray_tpu.get(refs, timeout=30.0)
+            assert all(o[0] == i for i, o in zip(range(wave, wave + 8), outs))
+            del refs, outs
+            gc.collect()
+        _wait(
+            lambda: daemon.store.stats()["objects"] < 40,
+            timeout=15.0,
+            msg=f"store grew unbounded: {daemon.store.stats()}",
+        )
+        s = daemon.store.stats()
+        assert s["bytes_in_memory"] <= 32 * 1024 * 1024
+        assert s["spilled"] < 30, f"GC too slow, spill flood: {s}"
+        # driver-side bookkeeping is bounded too (lineage dropped)
+        rt = ray_tpu.core.api._get_runtime()
+        _wait(lambda: len(rt._task_meta) < 30, timeout=10.0,
+              msg=f"lineage leak: {len(rt._task_meta)} metas")
+        assert len(rt._refcounts) < 60
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_lineage_pinned_while_downstream_ref_alive():
+    """A producer's spec survives its own refs' death while a consumer ref
+    is alive (transitive lineage pinning); both drop afterwards."""
+    cluster = Cluster()
+    cluster.add_node(num_cpus=2)
+    ray_tpu.init(address=cluster.address)
+    try:
+        @ray_tpu.remote
+        def produce():
+            return np.arange(50_000)  # too big to inline
+
+        @ray_tpu.remote
+        def consume(x):
+            return int(x[-1])
+
+        src = produce.remote()
+        src_tid = src.task_id
+        out = consume.remote(src)
+        assert ray_tpu.get(out, timeout=20.0) == 49_999
+        rt = ray_tpu.core.api._get_runtime()
+        del src
+        gc.collect()
+        time.sleep(0.5)  # a few GC cycles
+        with rt._lock:
+            assert src_tid in rt._task_meta, \
+                "producer lineage dropped while consumer ref alive"
+        del out
+        gc.collect()
+        _wait(lambda: src_tid not in rt._task_meta, timeout=10.0,
+              msg="producer lineage not cascaded after consumer drop")
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
