@@ -101,6 +101,34 @@ class ObjectStore:
                 pass
         return payload
 
+    def object_size(self, oid: str) -> Optional[int]:
+        with self._lock:
+            payload = self._data.get(oid)
+            if payload is not None:
+                return len(payload)
+            path = self._spilled.get(oid)
+        if path is not None:
+            try:
+                return os.path.getsize(path)
+            except OSError:
+                return None
+        return None
+
+    def read_range(self, oid: str, offset: int, length: int) -> Optional[bytes]:
+        with self._lock:
+            payload = self._data.get(oid)
+            if payload is not None:
+                return payload[offset:offset + length]
+            path = self._spilled.get(oid)
+        if path is not None:
+            try:
+                with open(path, "rb") as f:
+                    f.seek(offset)
+                    return f.read(length)
+            except OSError:
+                return None
+        return None
+
     def contains(self, oid: str) -> bool:
         with self._lock:
             return oid in self._data or oid in self._spilled

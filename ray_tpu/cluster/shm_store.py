@@ -158,6 +158,71 @@ class ShmNodeStore:
                 return None
             time.sleep(0.005)
 
+    # ------------------------------------------------- chunked transfer
+
+    def object_size(self, oid: str) -> Optional[int]:
+        key = shm_key(oid)
+        view = self.shm.get(key)
+        if view is not None:
+            try:
+                return len(view)
+            finally:
+                self.shm.release(key)
+        with self._lock:
+            path = self._spilled.get(oid)
+        if path is not None:
+            try:
+                return os.path.getsize(path)
+            except OSError:
+                return None
+        return None
+
+    def read_range(self, oid: str, offset: int, length: int) -> Optional[bytes]:
+        """One transfer chunk (reference: object_manager.cc serves objects
+        in object_buffer_pool chunks)."""
+        key = shm_key(oid)
+        view = self.shm.get(key)
+        if view is not None:
+            try:
+                return bytes(view[offset:offset + length])
+            finally:
+                self.shm.release(key)
+        with self._lock:
+            path = self._spilled.get(oid)
+        if path is not None:
+            try:
+                with open(path, "rb") as f:
+                    f.seek(offset)
+                    return f.read(length)
+            except OSError:
+                return None
+        return None
+
+    def begin_streaming_put(self, oid: str, size: int):
+        """Writable buffer for an incoming chunked pull (created, unsealed);
+        None when it can't be allocated or already exists."""
+        key = shm_key(oid)
+        buf = None
+        try:
+            buf = self.shm.create_buffer(key, size, allow_evict=False)
+        except ObjectExistsError:
+            return None
+        except StoreFullError:
+            self.make_room(size)
+            try:
+                buf = self.shm.create_buffer(key, size, allow_evict=False)
+            except (StoreFullError, ObjectExistsError):
+                return None
+        with self._lock:
+            self._known[key] = oid
+        return buf
+
+    def commit_streaming_put(self, oid: str) -> None:
+        self.shm.seal(shm_key(oid))
+
+    def abort_streaming_put(self, oid: str) -> None:
+        self.shm.delete(shm_key(oid))
+
     # ----------------------------------------------------------------- misc
 
     def contains(self, oid: str) -> bool:
