@@ -23,7 +23,7 @@ import numpy as np
 
 from ray_tpu.core.config import Config
 from ray_tpu.cluster.rpc import RpcServer
-from ray_tpu.sched.policy import make_policy
+from ray_tpu.sched.policy import make_policy_from_config
 from ray_tpu.sched.resources import NodeResourceState, ResourceSpace
 from ray_tpu.sched import bundles as bundles_mod
 
@@ -35,7 +35,7 @@ class GcsServer:
         self.config = config or Config()
         self.space = ResourceSpace()
         self.state = NodeResourceState(space=self.space)
-        self.policy = make_policy(self.config.scheduling_policy)
+        self.policy = make_policy_from_config(self.config)
         self._lock = threading.RLock()
 
         # --- tables (reference: gcs_table_storage.cc) ---

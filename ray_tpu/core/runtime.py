@@ -35,7 +35,7 @@ from ray_tpu.core.exceptions import (
 from ray_tpu.core.memory_store import MemoryStore
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.task_spec import TaskSpec, new_id
-from ray_tpu.sched.policy import make_policy
+from ray_tpu.sched.policy import make_policy_from_config
 from ray_tpu.sched.resources import NodeResourceState, ResourceSpace
 
 _context = threading.local()
@@ -74,7 +74,7 @@ class LocalRuntime:
         self.state = NodeResourceState(space=self.space)
         self.state.add_node(self.node_id, res)
         self.store = MemoryStore()
-        self.policy = make_policy(self.config.scheduling_policy)
+        self.policy = make_policy_from_config(self.config)
 
         self._lock = threading.Lock()
         self._pending: deque = deque()  # schedulable TaskSpecs

@@ -41,13 +41,27 @@ class SchedulingPolicy:
 class HybridPolicy(SchedulingPolicy):
     """Default policy: pack-until-threshold then spread (reference:
     hybrid_scheduling_policy.cc). backend="jax" keeps the cluster view
-    device-resident via kernel_jax.JaxScheduler."""
+    device-resident via kernel_jax.JaxScheduler.
 
-    def __init__(self, spread_threshold: float = 0.5, backend: str = "numpy"):
+    Incremental device sync: between rounds the control plane mutates node
+    availability through NodeResourceState.allocate/release, which records
+    dirty row indices. The jax backend uploads ONLY those rows
+    (JaxScheduler.update_rows) instead of the full [N, R] view; a full
+    re-upload happens only on topology change or every
+    FULL_SYNC_INTERVAL rounds (drift guard for non-dyadic fractional
+    demands, whose subtraction order can differ host vs device by 1 ulp).
+    """
+
+    FULL_SYNC_INTERVAL = 64
+
+    def __init__(self, spread_threshold: float = 0.5, backend: str = "numpy",
+                 algo: str = "scan"):
         self.spread_threshold = spread_threshold
         self.backend = backend
+        self.algo = algo
         self._jax = None  # lazily built JaxScheduler (topology-dependent)
         self._topology_key = None
+        self._rounds_since_full_sync = 0
 
     @property
     def name(self):
@@ -60,21 +74,45 @@ class HybridPolicy(SchedulingPolicy):
         if self._jax is None or self._topology_key != key:
             self._jax = JaxScheduler(state.total, state.alive)
             self._topology_key = key
-        self._jax.set_available(state.available)
+            state.consume_dirty()  # fresh build IS the sync
+            self._jax.set_available(state.available)
+            self._rounds_since_full_sync = 0
+            return self._jax
+        dirty = state.consume_dirty()
+        n = len(state.node_ids)
+        if (
+            self._rounds_since_full_sync >= self.FULL_SYNC_INTERVAL
+            or len(dirty) * 2 >= n
+        ):
+            self._jax.set_available(state.available)
+            self._rounds_since_full_sync = 0
+        elif dirty:
+            self._jax.update_rows(dirty, state.available[dirty])
         return self._jax
 
     def schedule(self, state, demands, counts):
         if self.backend == "jax":
             sched = self._jax_sched(state)
-            assigned = sched.schedule(demands, counts, self.spread_threshold)
-            # keep the host view authoritative (device copy is a cache)
+            self._rounds_since_full_sync += 1
+            assigned = sched.schedule(
+                demands, counts, self.spread_threshold, algo=self.algo
+            )
+            # keep the host view authoritative (device copy is a cache);
+            # this assignment bypasses dirty tracking on purpose — the
+            # device already holds the post-schedule view (kernel output)
             taken = assigned.astype(np.float32).T @ demands  # [N, R]
             state.available = np.maximum(state.available - taken, 0.0)
             return assigned
-        assigned, new_avail = kernel_np.schedule_classes(
-            state.available, state.total, state.alive, demands, counts,
-            spread_threshold=self.spread_threshold,
-        )
+        if self.algo == "rounds":
+            assigned, new_avail = kernel_np.schedule_classes_rounds(
+                state.available, state.total, state.alive, demands, counts,
+                spread_threshold=self.spread_threshold,
+            )
+        else:
+            assigned, new_avail = kernel_np.schedule_classes(
+                state.available, state.total, state.alive, demands, counts,
+                spread_threshold=self.spread_threshold,
+            )
         state.available = new_avail
         return assigned
 
@@ -142,6 +180,18 @@ _POLICIES = {
     "jax_tpu": lambda **kw: HybridPolicy(backend="jax", **kw),
     "spread": lambda **kw: SpreadPolicy(),
 }
+
+
+def make_policy_from_config(config) -> SchedulingPolicy:
+    """Build the cluster scheduling policy from a Config (the composite
+    dispatch point — reference: composite_scheduling_policy.cc reading
+    RAY_CONFIG knobs)."""
+    kw = {}
+    name = config.scheduling_policy
+    if name in ("hybrid", "jax_tpu"):
+        kw["spread_threshold"] = config.scheduler_spread_threshold
+        kw["algo"] = config.scheduler_kernel_algo
+    return make_policy(name, **kw)
 
 
 def make_policy(name: str, **kwargs) -> SchedulingPolicy:

@@ -123,6 +123,12 @@ class NodeResourceState:
         if self.alive is None:
             self.alive = np.zeros((0,), dtype=bool)
         self._index: Dict[str, int] = {nid: i for i, nid in enumerate(self.node_ids)}
+        # Row indices whose availability changed since the last consume_dirty()
+        # — the incremental-upload feed for device-resident scheduler views
+        # (kernel_jax.JaxScheduler.update_rows). Mirrors the role of the
+        # reference's resource-sync deltas (ray_syncer.cc): ship only what
+        # changed, not the whole cluster view, every round.
+        self.dirty_rows: set = set()
 
     def __len__(self) -> int:
         return len(self.node_ids)
@@ -171,6 +177,7 @@ class NodeResourceState:
         """Overwrite a node's availability from a sync report (ray_syncer-style)."""
         idx = self._index[node_id]
         self.available[idx] = self.space.vector(available)
+        self.dirty_rows.add(idx)
 
     def allocate(self, node_idx: int, demand: np.ndarray) -> bool:
         """Try to deduct `demand` from node `node_idx`. Returns False if it no
@@ -181,6 +188,7 @@ class NodeResourceState:
             return False
         self.available[node_idx] -= demand
         np.maximum(self.available[node_idx], 0.0, out=self.available[node_idx])
+        self.dirty_rows.add(int(node_idx))
         return True
 
     def release(self, node_idx: int, demand: np.ndarray) -> None:
@@ -189,6 +197,14 @@ class NodeResourceState:
         self.available[node_idx] = np.minimum(
             self.available[node_idx] + demand, self.total[node_idx]
         )
+        self.dirty_rows.add(int(node_idx))
+
+    def consume_dirty(self) -> List[int]:
+        """Return-and-clear the changed row indices (sorted). The device view
+        consumer uploads exactly these rows, then the set starts fresh."""
+        out = sorted(self.dirty_rows)
+        self.dirty_rows.clear()
+        return out
 
     def feasible_anywhere(self, demand: np.ndarray) -> bool:
         """Is there any node whose *total* resources cover the demand?
