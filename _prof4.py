@@ -1,0 +1,21 @@
+import time, numpy as np, jax, jax.numpy as jnp
+jax.config.update("jax_compilation_cache_dir", "/tmp/ray_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+from ray_tpu.sched import kernel_jax
+import bench as B
+rng = np.random.default_rng(0)
+total, alive, demands, counts = B.build_stream_problem(rng)
+dev = jax.devices()[0]
+sched = kernel_jax.JaxScheduler(total, alive, device=dev)
+d = jax.device_put(jnp.asarray(demands), dev)
+active = tuple(int(i) for i in np.flatnonzero((demands > 0).any(axis=0)))
+count_variants = [jax.device_put(jnp.asarray(np.maximum(counts + rng.integers(-50, 50, counts.shape), 0).astype(np.int32)), dev) for _ in range(10)]
+def run_rounds(k):
+    return kernel_jax.schedule_classes_rounds(sched.total, sched.total, sched.alive, d, k, active_idx=active)
+t0=time.time(); r = run_rounds(count_variants[0]); jax.block_until_ready(r)
+print(f"rounds4(nosort) compile+1st: {time.time()-t0:.1f}s", flush=True)
+ts = []
+for k in count_variants:
+    t0 = time.perf_counter(); r = run_rounds(k); jax.block_until_ready(r)
+    ts.append(time.perf_counter() - t0)
+print(f"rounds4(nosort): median {np.median(ts)*1e3:.1f}ms min {min(ts)*1e3:.1f}ms placed={int(np.asarray(r[0]).sum())}", flush=True)

@@ -698,7 +698,7 @@ class GcsServer:
                     "strategy": strategy, "nodes": None,
                 }
                 return {"ok": False, "state": "PENDING"}
-            self.state.available = new_avail
+            self.state.replace_available(new_avail)
             node_ids = [self.state.node_ids[i] for i in nodes_idx]
             self.placement_groups[pg_id] = {
                 "pg_id": pg_id, "state": "CREATED", "bundles": bundles,
@@ -927,7 +927,7 @@ class GcsServer:
             )
             if nodes_idx is None:
                 continue
-            self.state.available = new_avail
+            self.state.replace_available(new_avail)
             node_ids = [self.state.node_ids[i] for i in nodes_idx]
             pg["state"] = "CREATED"
             pg["nodes"] = node_ids

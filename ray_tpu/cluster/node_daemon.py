@@ -217,6 +217,11 @@ class NodeDaemon:
         self._pending_rpc: Dict[str, Any] = {}  # task_id -> asyncio future (actor calls)
         self._peer_clients: Dict[str, RpcClient] = {}
         self._bundles: Dict[str, dict] = {}
+        # chunked-pull state: per-peer concurrency caps, same-object dedupe,
+        # and a transfer counter (observable in tests/metrics)
+        self._pull_sems: Dict[str, threading.Semaphore] = {}
+        self._inflight_pulls: Dict[str, threading.Event] = {}
+        self._chunks_pulled = 0
 
         self.server = RpcServer(
             self._handle, host=host, port=0,
@@ -426,12 +431,32 @@ class NodeDaemon:
         )
 
     def rpc_fetch_object(self, p, conn):
-        """Peer daemons / drivers fetch a locally-stored object."""
+        """Peer daemons / drivers fetch a locally-stored object whole (small
+        objects; big ones go through object_info + fetch_chunk)."""
         timeout = p.get("timeout", 0.0)
         if timeout <= 0:
             return self.store.get(p["object_id"], timeout=0.0)
         return self.server.loop.run_in_executor(
             None, lambda: self.store.get(p["object_id"], timeout=timeout)
+        )
+
+    def rpc_object_info(self, p, conn):
+        """Size probe ahead of a pull: lets the puller pick whole-frame vs
+        chunked (reference: object directory size metadata consulted by
+        pull_manager.cc before requesting pushes)."""
+        return {"size": self.store.object_size(p["object_id"])}
+
+    def rpc_fetch_chunk(self, p, conn):
+        """One bounded piece of an object (reference: object_manager.cc
+        serves objects in object_buffer_pool chunks over gRPC). Off the
+        event loop: read_range may touch spilled files on disk. Each reply
+        frame is ~chunk-sized, so a 2GB object never occupies the peer's
+        event loop or one giant pickle frame."""
+        return self.server.loop.run_in_executor(
+            None,
+            lambda: self.store.read_range(
+                p["object_id"], int(p["offset"]), int(p["length"])
+            ),
         )
 
     def rpc_make_room(self, p, conn):
@@ -510,9 +535,9 @@ class NodeDaemon:
             for oid in missing:
                 if self._stopped:
                     return
-                if self._get_object_bytes(
+                if not self._ensure_local(
                     oid, timeout=self.config.object_fetch_timeout_s
-                ) is None:
+                ):
                     self._report_done(
                         t, status="DEPS_UNAVAILABLE",
                         error=f"arg object {oid[:8]} unavailable on "
@@ -636,42 +661,162 @@ class NodeDaemon:
     # ------------------------------------------------------------- transfers
 
     def _get_object_bytes(self, oid: str, timeout: float) -> Optional[bytes]:
-        payload = self.store.get(oid, timeout=0.0)
-        if payload is not None:
-            return payload
+        if self._ensure_local(oid, timeout):
+            return self.store.get(oid, timeout=1.0)
+        return None
+
+    def _ensure_local(self, oid: str, timeout: float) -> bool:
+        """Make the object resident in the local store (pulling from a peer
+        if needed) without materializing an extra host copy — chunked pulls
+        stream straight into a pre-allocated shm buffer."""
+        if self.store.contains(oid):
+            return True
         deadline = time.time() + timeout
         while time.time() < deadline and not self._stopped:
+            # same-object dedupe: one puller does the transfer, the rest wait
+            with self._lock:
+                ev = self._inflight_pulls.get(oid)
+                if ev is None:
+                    ev = threading.Event()
+                    self._inflight_pulls[oid] = ev
+                    i_pull = True
+                else:
+                    i_pull = False
+            if not i_pull:
+                ev.wait(timeout=max(0.0, deadline - time.time()))
+                if self.store.contains(oid):
+                    return True
+                continue  # puller failed; take over on the next lap
             try:
-                loc = self.gcs.call("locate_object", {"object_id": oid})
-            except Exception:
-                return None
-            for entry in loc.get("nodes", []):
-                if entry["node_id"] == self.node_id:
-                    continue
-                peer = self._peer(entry["node_id"], entry["addr"], entry["port"])
-                if peer is None:
-                    continue
                 try:
-                    payload = peer.call(
-                        "fetch_object", {"object_id": oid, "timeout": 5.0},
-                        timeout=30.0,
-                    )
+                    loc = self.gcs.call("locate_object", {"object_id": oid})
                 except Exception:
-                    payload = None
-                if payload is not None:
-                    self.store.put(oid, payload)
+                    return False
+                for entry in loc.get("nodes", []):
+                    if entry["node_id"] == self.node_id:
+                        continue
+                    peer = self._peer(
+                        entry["node_id"], entry["addr"], entry["port"]
+                    )
+                    if peer is None:
+                        continue
+                    if self._pull_from_peer(
+                        peer, entry["node_id"], oid, deadline
+                    ):
+                        try:
+                            self.gcs.call("add_object_location", {
+                                "object_id": oid, "node_id": self.node_id,
+                            })
+                        except Exception:
+                            pass
+                        return True
+            finally:
+                with self._lock:
+                    self._inflight_pulls.pop(oid, None)
+                ev.set()
+            # object may be produced by an in-flight task: wait for local
+            if self.store.get(oid, timeout=0.2) is not None:
+                return True
+        return self.store.contains(oid)
+
+    def _pull_from_peer(self, peer: RpcClient, peer_node_id: str,
+                        oid: str, deadline: float) -> bool:
+        chunk_bytes = self.config.object_transfer_chunk_bytes
+        try:
+            info = peer.call("object_info", {"object_id": oid}, timeout=10.0)
+        except Exception:
+            return False
+        size = (info or {}).get("size")
+        if size is None:
+            return False
+        if size <= chunk_bytes:
+            try:
+                payload = peer.call(
+                    "fetch_object", {"object_id": oid, "timeout": 5.0},
+                    timeout=30.0,
+                )
+            except Exception:
+                return False
+            if payload is None:
+                return False
+            self.store.put(oid, payload)
+            return True
+        return self._pull_chunked(
+            peer, peer_node_id, oid, size, chunk_bytes, deadline
+        )
+
+    def _pull_chunked(self, peer: RpcClient, peer_node_id: str, oid: str,
+                      size: int, chunk_bytes: int, deadline: float) -> bool:
+        """Stream a big object in chunk_bytes pieces with a bounded pipeline
+        window, at most object_pull_max_concurrent big pulls per peer
+        (reference: pull_manager.cc + object_buffer_pool.cc). The peer's
+        event loop only ever sees chunk-sized frames, so its small-RPC
+        latency stays bounded during the transfer."""
+        with self._lock:
+            sem = self._pull_sems.get(peer_node_id)
+            if sem is None:
+                sem = threading.Semaphore(
+                    max(int(self.config.object_pull_max_concurrent), 1)
+                )
+                self._pull_sems[peer_node_id] = sem
+        with sem:
+            buf = None
+            if hasattr(self.store, "begin_streaming_put"):
+                buf = self.store.begin_streaming_put(oid, size)
+            assemble = bytearray(size) if buf is None else None
+            dst = buf if buf is not None else memoryview(assemble)
+            window = max(int(self.config.object_pull_window), 1)
+            offsets = list(range(0, size, chunk_bytes))
+            inflight: Dict[int, Any] = {}  # offset -> future
+            # A big healthy transfer may legitimately outlive the caller's
+            # fetch deadline; grant a bandwidth-floor allowance (10MB/s)
+            # beyond it so only genuinely stalled pulls abort, and cap every
+            # chunk wait so one dead peer never wedges the pull thread.
+            xfer_deadline = max(deadline, time.time()) + size / (10 << 20)
+            try:
+                oi = 0
+                while oi < len(offsets) or inflight:
+                    while oi < len(offsets) and len(inflight) < window:
+                        off = offsets[oi]
+                        inflight[off] = peer.call_async(
+                            "fetch_chunk",
+                            {"object_id": oid, "offset": off,
+                             "length": min(chunk_bytes, size - off)},
+                        )
+                        oi += 1
+                    # drain the oldest outstanding chunk (send order is
+                    # frame order at the peer, so oldest completes first)
+                    wait = min(30.0, xfer_deadline - time.time())
+                    if wait <= 0:
+                        raise TimeoutError(f"pull of {oid[:8]} overran deadline")
+                    off = next(iter(inflight))
+                    data = inflight.pop(off).result(timeout=wait)
+                    want = min(chunk_bytes, size - off)
+                    if data is None or len(data) != want:
+                        # vanished at the peer, or a short read (truncated
+                        # spill file): sealing would register a corrupt
+                        # replica that then propagates to every puller
+                        raise LookupError(
+                            f"chunk at {off}: got "
+                            f"{0 if data is None else len(data)}/{want} bytes"
+                        )
+                    dst[off:off + len(data)] = data
+                    self._chunks_pulled += 1
+                if buf is not None:
+                    self.store.commit_streaming_put(oid)
+                else:
+                    # hand the bytearray over as-is: stores treat payloads
+                    # as read-only buffers, and bytes(assemble) would double
+                    # transient memory exactly when the node is pressured
+                    self.store.put(oid, assemble)
+                return True
+            except Exception:
+                if buf is not None:
                     try:
-                        self.gcs.call("add_object_location", {
-                            "object_id": oid, "node_id": self.node_id,
-                        })
+                        self.store.abort_streaming_put(oid)
                     except Exception:
                         pass
-                    return payload
-            # object may be produced by an in-flight task: wait for local
-            payload = self.store.get(oid, timeout=0.2)
-            if payload is not None:
-                return payload
-        return None
+                return False
 
     def _peer(self, node_id, addr, port) -> Optional[RpcClient]:
         with self._lock:

@@ -1,0 +1,89 @@
+"""Shared GCS test/bench harness: drive _schedule_round by hand.
+
+Tests and the benchmark both need a GcsServer whose scheduling rounds are
+driven manually (a background round racing manual ones would split the
+pending queue into different batches per run, which legitimately changes
+hybrid-policy decisions). The park/drain choreography lives here once,
+mirroring how the reference centralizes cluster-fixture plumbing in
+python/ray/tests/conftest.py + ray.cluster_utils.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Optional
+
+
+class FakeConn:
+    """Stands in for an RPC connection in direct-call harnesses."""
+
+    def __init__(self, conn_id: int = 999):
+        self.conn_id = conn_id
+        self.meta: Dict = {}
+
+
+def park_scheduler_loop(gcs, timeout: float = 10.0) -> None:
+    """Stop the GCS's background scheduler thread so manual
+    _schedule_round calls own the queue. Kicks until the thread actually
+    exits (a single notify can race the loop between wait and re-wait)."""
+    gcs._stopped = True
+    deadline = time.time() + timeout
+    while gcs._sched_thread.is_alive():
+        gcs._kick()
+        gcs._sched_thread.join(timeout=0.2)
+        if time.time() > deadline:
+            raise RuntimeError("scheduler thread failed to park")
+    gcs._stopped = False  # keep rpc paths (and shutdown) on normal behavior
+
+
+def register_fake_nodes(gcs, n_nodes: int,
+                        resources_fn: Callable[[int], dict]) -> None:
+    for i in range(n_nodes):
+        gcs.rpc_register_node(
+            {
+                "node_id": f"node-{i}",
+                "addr": "127.0.0.1",
+                "port": 20000 + i,
+                "resources": resources_fn(i),
+            },
+            FakeConn(conn_id=10_000 + i),
+        )
+
+
+def complete_running(gcs, task_ids) -> None:
+    """Finish tasks the way rpc_task_done's accounting does: drop the
+    running entry, exit the output tracker, release the node's resources."""
+    for tid in task_ids:
+        with gcs._lock:
+            info = gcs.running.pop(tid, None)
+            if info is None:
+                continue
+            gcs._track_exit(info.get("meta", {}))
+            idx = gcs.state.node_index(info["node_id"])
+            if idx is not None:
+                gcs.state.release(idx, info["demand"])
+
+
+def run_rounds_to_quiescence(
+    gcs,
+    max_rounds: int = 400,
+    drain_fraction: float = 0.5,
+) -> Dict[str, str]:
+    """Alternate _schedule_round with completing a slice of running tasks
+    (freeing resources — the dirty-row release path) until the queue drains.
+    Returns {task_id: node_id} placements in dispatch order."""
+    placements: Dict[str, str] = {}
+    for _ in range(max_rounds):
+        gcs._schedule_round()
+        with gcs._lock:
+            for tid, info in gcs.running.items():
+                if tid not in placements:
+                    placements[tid] = info["node_id"]
+            running = sorted(gcs.running)
+        complete_running(
+            gcs, running[: max(int(len(running) * drain_fraction), 1)]
+        )
+        with gcs._lock:
+            if not gcs.pending and not gcs.running:
+                break
+    return placements

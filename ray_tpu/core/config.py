@@ -29,6 +29,12 @@ _DEFS: Dict[str, tuple] = {
     "object_store_memory_bytes": (int, 256 * 1024 * 1024),
     "object_spilling_dir": (str, ""),  # empty -> <session_dir>/spill
     "object_transfer_chunk_bytes": (int, 1024 * 1024),
+    # concurrent big-object pulls per peer daemon; more pulls queue behind a
+    # semaphore (reference: pull_manager.cc prioritized, bandwidth-bounded
+    # pull bundles)
+    "object_pull_max_concurrent": (int, 2),
+    # in-flight chunk requests per pull (pipelining window)
+    "object_pull_window": (int, 8),
     # daemon-side arg prefetch bound; short on purpose — on failure the task
     # returns to the GCS dependency gate, which holds it until the object
     # actually exists (so slow producers don't need a long timeout here)

@@ -181,29 +181,6 @@ def _threshold_cap_matrix(avail, total, demands, thr):
     return jnp.clip(k, 0.0, jnp.float32(INF_FIT) - 1.0) + 1.0
 
 
-def _counting_sort_perm(bucket: jnp.ndarray, n_buckets: int = SCORE_BUCKETS):
-    """Stable sort permutation for small-int keys via one-hot prefix sums.
-
-    Returns (order, inv) with order == argsort(bucket, stable) and
-    inv == its inverse (inv[n] = final position of node n). position =
-    bucket offset + stable rank within bucket, built from [B, N] cumsums —
-    all VPU work, no sort."""
-    n = bucket.shape[0]
-    onehot = (bucket[None, :] == jnp.arange(n_buckets)[:, None]).astype(
-        jnp.int32
-    )  # [B, N]
-    within = jnp.cumsum(onehot, axis=1) - onehot  # exclusive rank in bucket
-    bucket_counts = onehot.sum(axis=1)  # [B]
-    offsets = jnp.concatenate(
-        [jnp.zeros((1,), jnp.int32), jnp.cumsum(bucket_counts)[:-1]]
-    )
-    pos = (onehot * (offsets[:, None] + within)).sum(axis=0)  # [N] = inv
-    order = jnp.zeros((n,), jnp.int32).at[pos].set(
-        jnp.arange(n, dtype=jnp.int32)
-    )
-    return order, pos
-
-
 # Saturation bound for prefix sums: float32 holds integers exactly up to
 # 2**24; saturating at 2**23 keeps every partial (<= SAT + element) exact.
 SAT = float(1 << 23)
@@ -236,11 +213,12 @@ def schedule_classes_rounds(
 
     Per global round, two phases (A: fill nodes only up to the spread
     threshold; B: equal-share the overflow across feasible nodes). Each phase:
-      1. nodes are ordered by quantized utilization bucket (one argsort per
-         phase, shared by all classes);
-      2. every class prefix-fills its capacity caps in that order
-         (exact fill via saturating-scan cumsum — no sort per class);
-      3. conflicts are resolved by class-priority: a class sees the
+      1. every class prefix-fills its capacity caps in node-index order
+         (exact fill via saturating-scan cumsum — no sort, no permutation
+         gathers: those dominated the round cost on TPU, and for phase A
+         index order IS sorted order since only under-threshold/bucket-0
+         nodes have nonzero cap);
+      2. conflicts are resolved by class-priority: a class sees the
          *claimed* usage of lower-indexed classes via a saturating cumsum
          over C, and trims its take to the remaining headroom — so the result
          is feasible by construction and close to sequentially scheduling
@@ -287,8 +265,7 @@ def schedule_classes_rounds(
         return jnp.clip(k, 0.0, jnp.float32(INF_FIT) - 1.0) + 1.0
 
     def claim_phase(avail_p, remaining, cap):
-        """cap [C, N] in bucket-permuted node order; avail_p likewise.
-        Returns take [C, N] (permuted order)."""
+        """cap [C, N] in node-index order; returns take [C, N]."""
         capc = jnp.minimum(cap, jnp.minimum(remaining[:, None], jnp.float32(SAT)))
         prev = _sat_cumsum(capc, axis=1) - capc  # along N (lanes)
         want = jnp.clip(remaining[:, None] - prev, 0.0, capc)
@@ -310,16 +287,15 @@ def schedule_classes_rounds(
         return jnp.clip(takeT.T, 0.0, want)
 
     def run_phase(avail, remaining, assigned, cap):
-        util = critical_util(avail, total)
-        bucket = _score_bucket(util, thr)
-        # stable counting sort by bucket: buckets are small ints (<64), so
-        # the permutation falls out of one-hot cumsums — no argsort on the
-        # hot path (TPU sorts on 10k keys cost ~10ms each; this is ~0.1ms).
-        # Identical to jnp.argsort(bucket, stable=True) + its inverse, which
-        # is what the NumPy twin computes.
-        order, inv = _counting_sort_perm(bucket)
-        take_p = claim_phase(avail[order], remaining, cap[:, order])
-        take = take_p[:, inv]
+        # Nodes are filled in node-index order (no utilization sort). For
+        # phase A this is EXACTLY the old sorted behavior: only bucket-0
+        # (under-threshold) nodes have nonzero cap, and stable sort keeps
+        # equal keys in index order. For phase B it is a deliberate
+        # divergence — the [C, N] permutation gathers the sort required were
+        # the kernel's dominant cost on TPU (~100ms of a 146ms round at
+        # 10k nodes), and the makespan simulator bounds the quality effect
+        # (tests/test_simulator.py, bench configs 1-3). NumPy twin matches.
+        take = claim_phase(avail, remaining, cap)
         usage = jnp.einsum("cn,cr->nr", take, demands)
         avail = jnp.maximum(avail - usage, 0.0)
         return avail, remaining - take.sum(axis=1), assigned + take

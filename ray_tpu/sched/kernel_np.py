@@ -312,13 +312,9 @@ def schedule_classes_rounds(
         return np.clip(take, 0.0, want)
 
     def run_phase(avail, remaining, assigned, cap):
-        util = critical_util(avail, total)
-        bucket = _score_bucket(util, thr)
-        order = np.argsort(bucket, kind="stable")
-        inv = np.zeros(N, np.int64)
-        inv[order] = np.arange(N)
-        take_p = claim_phase(avail[order], remaining, cap[:, order])
-        take = take_p[:, inv]
+        # node-index fill order, matching the jax twin (see its run_phase
+        # comment: exact for phase A, a measured quality tradeoff for B)
+        take = claim_phase(avail, remaining, cap)
         usage = np.einsum("cn,cr->nr", take, demands).astype(np.float32)
         avail = np.maximum(avail - usage, 0.0)
         return avail, remaining - take.sum(axis=1), assigned + take

@@ -113,7 +113,7 @@ class HybridPolicy(SchedulingPolicy):
                 state.available, state.total, state.alive, demands, counts,
                 spread_threshold=self.spread_threshold,
             )
-        state.available = new_avail
+        state.replace_available(new_avail)
         return assigned
 
 
@@ -134,7 +134,7 @@ class SpreadPolicy(SchedulingPolicy):
             nodes, new_avail = kernel_np.spread_assign(
                 state.available, state.total, state.alive, expand, start=self._cursor
             )
-            state.available = new_avail
+            state.replace_available(new_avail)
             placed = nodes[nodes >= 0]
             if len(placed):
                 np.add.at(assigned[c], placed, 1)

@@ -199,6 +199,14 @@ class NodeResourceState:
         )
         self.dirty_rows.add(int(node_idx))
 
+    def replace_available(self, new_avail: np.ndarray) -> None:
+        """Wholesale availability swap (bundle packing returns a full new
+        matrix) that keeps the dirty-row contract: every changed row is
+        marked so device-view consumers stay in sync."""
+        changed = np.flatnonzero((self.available != new_avail).any(axis=1))
+        self.dirty_rows.update(int(i) for i in changed)
+        self.available = new_avail
+
     def consume_dirty(self) -> List[int]:
         """Return-and-clear the changed row indices (sorted). The device view
         consumer uploads exactly these rows, then the set starts fresh."""

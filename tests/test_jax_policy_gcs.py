@@ -16,15 +16,15 @@ import numpy as np
 import pytest
 
 from ray_tpu.core.config import Config
+from ray_tpu.cluster.testing import (
+    FakeConn,
+    park_scheduler_loop,
+    register_fake_nodes,
+    run_rounds_to_quiescence,
+)
 from ray_tpu.sched.kernel_jax import JaxScheduler
 from ray_tpu.sched.policy import make_policy_from_config
 from ray_tpu.sched.resources import NodeResourceState, ResourceSpace
-
-
-class _FakeConn:
-    def __init__(self, conn_id=999):
-        self.conn_id = conn_id
-        self.meta = {}
 
 
 def _boot_gcs(policy_name, n_nodes=64, algo="scan"):
@@ -37,30 +37,15 @@ def _boot_gcs(policy_name, n_nodes=64, algo="scan"):
             "scheduler_round_interval_ms": 60_000.0,
         })
     )
-    # Tests drive _schedule_round by hand: a background round racing the
-    # manual ones would split the pending queue into different batches on
-    # each run (batch composition legitimately shapes decisions), so the
-    # loop thread is parked. _kick() wakes it once; it exits on _stopped.
-    gcs._stopped = True
-    gcs._kick()
-    gcs._sched_thread.join(timeout=5)
-    gcs._stopped = False  # keep rpc paths (and shutdown) on normal behavior
-    conn = _FakeConn()
+    park_scheduler_loop(gcs)
     rng = np.random.default_rng(42)
-    for i in range(n_nodes):
-        gcs.rpc_register_node(
-            {
-                "node_id": f"node-{i}",
-                "addr": "127.0.0.1",
-                "port": 20000 + i,
-                "resources": {
-                    "CPU": int(rng.integers(8, 65)),
-                    "memory": int(rng.integers(32, 257)),
-                },
-            },
-            _FakeConn(conn_id=1000 + i),
-        )
-    return gcs, conn
+    cpus = rng.integers(8, 65, n_nodes)
+    mems = rng.integers(32, 257, n_nodes)
+    register_fake_nodes(
+        gcs, n_nodes,
+        lambda i: {"CPU": int(cpus[i]), "memory": int(mems[i])},
+    )
+    return gcs, FakeConn()
 
 
 def _submit_workload(gcs, conn, n_tasks, seed=7):
@@ -82,38 +67,6 @@ def _submit_workload(gcs, conn, n_tasks, seed=7):
         )
 
 
-def _run_rounds_to_quiescence(gcs, max_rounds=200):
-    """Call _schedule_round until the queue drains or nothing moves,
-    completing a slice of running tasks between rounds so resources free up
-    (exercising the dirty-row release path)."""
-    placements = {}
-    for _ in range(max_rounds):
-        gcs._schedule_round()
-        with gcs._lock:
-            new = {
-                tid: info["node_id"]
-                for tid, info in gcs.running.items()
-                if tid not in placements
-            }
-            placements.update(new)
-            # complete the oldest half of running tasks -> release resources
-            running = sorted(gcs.running)
-            done_now = running[: max(len(running) // 2, 1)]
-        for tid in done_now:
-            with gcs._lock:
-                info = gcs.running.pop(tid, None)
-                if info is None:
-                    continue
-                gcs._track_exit(info.get("meta", {}))
-                idx = gcs.state.node_index(info["node_id"])
-                if idx is not None:
-                    gcs.state.release(idx, info["demand"])
-        with gcs._lock:
-            if not gcs.pending and not gcs.running:
-                break
-    return placements
-
-
 @pytest.mark.parametrize("algo", ["scan", "rounds"])
 def test_jax_policy_decisions_match_numpy_in_gcs(algo):
     n_tasks = 3000
@@ -123,8 +76,8 @@ def test_jax_policy_decisions_match_numpy_in_gcs(algo):
         assert gcs_jx.policy.name == "jax_tpu"
         _submit_workload(gcs_np, conn_np, n_tasks)
         _submit_workload(gcs_jx, conn_jx, n_tasks)
-        p_np = _run_rounds_to_quiescence(gcs_np)
-        p_jx = _run_rounds_to_quiescence(gcs_jx)
+        p_np = run_rounds_to_quiescence(gcs_np)
+        p_jx = run_rounds_to_quiescence(gcs_jx)
         assert len(p_np) == n_tasks, "numpy policy failed to place all tasks"
         assert len(p_jx) == n_tasks, "jax policy failed to place all tasks"
         mismatches = {
@@ -147,7 +100,7 @@ def test_jax_policy_10k_tasks_through_gcs():
     gcs, conn = _boot_gcs("jax_tpu", n_nodes=64)
     try:
         _submit_workload(gcs, conn, 10_000, seed=3)
-        placements = _run_rounds_to_quiescence(gcs, max_rounds=400)
+        placements = run_rounds_to_quiescence(gcs, max_rounds=400)
         assert len(placements) == 10_000
         with gcs._lock:
             assert not gcs.pending
