@@ -111,12 +111,18 @@ class ClusterClient:
         self._reconstructing: set = set()  # producer task_ids being re-run
         # ---- distributed reference counting (owner side) ----
         # Semantics from reference_count.cc (owned refs, task-duration arg
-        # pins, lineage pinned while outputs live), not its implementation:
-        # counting is owner-local; borrowers are kept alive by the owner's
-        # in-flight pin for the duration of the borrowing task. v1 gap:
-        # long-lived borrows (a worker stashing a ref past its task) are
-        # not tracked.
+        # pins, lineage pinned while outputs live, BORROWS), not its
+        # implementation: counting is owner-local; a task that stashes an
+        # arg ref past its lifetime is reported as a borrower in its result
+        # (worker.py _collect_borrows), and the owner holds a borrow pin per
+        # (oid, borrower) until the borrower releases it or dies.
         self._refcounts: Dict[str, list] = {}  # oid -> [local, pinned]
+        self._borrows: Dict[str, set] = {}  # oid -> {borrower worker_ids}
+        # A borrow_released can arrive BEFORE its borrow_added: the add rides
+        # the direct daemon reply while the release rides the GCS push
+        # connection — different reader threads, no ordering. Early releases
+        # park here as tombstones the late add consumes instead of pinning.
+        self._early_borrow_releases: Dict[str, set] = {}
         self._task_pins: Dict[str, list] = {}  # task_id -> pinned oids
         self._task_outputs: Dict[str, set] = {}  # task_id -> live output oids
         self._task_out_ids: Dict[str, list] = {}  # task_id -> all output oids
@@ -128,6 +134,8 @@ class ClusterClient:
         self.gcs.subscribe("task_result", self._on_task_result)
         self.gcs.subscribe("actor_update", self._on_actor_update)
         self.gcs.subscribe("nodes", self._on_nodes)
+        self.gcs.subscribe("borrow_added", self._on_borrow_added)
+        self.gcs.subscribe("borrow_released", self._on_borrow_released)
         self.gcs.on_close = self._on_gcs_lost
         reply = self.gcs.call("register_driver", {"driver_id": self.worker_id})
         self._nodes: Dict[str, dict] = reply["nodes"]
@@ -159,6 +167,51 @@ class ClusterClient:
             free = rc[0] <= 0 and rc[1] <= 0
         if free:
             self._queue_free(oid)
+
+    def _apply_borrows(self, p: dict) -> None:
+        """Borrows reported in a task result: pin each (oid, borrower) pair
+        BEFORE the task's arg pins release (same handler, so ordered)."""
+        for b in p.get("borrows") or ():
+            if b.get("owner") == self.worker_id:
+                self._add_borrow(b["id"], p.get("borrow_worker"))
+
+    def _add_borrow(self, oid: str, worker_id) -> None:
+        with self._lock:
+            early = self._early_borrow_releases.get(oid)
+            if early is not None and worker_id in early:
+                early.discard(worker_id)
+                if not early:
+                    del self._early_borrow_releases[oid]
+                return  # release already arrived; never pin
+            s = self._borrows.setdefault(oid, set())
+            if worker_id in s:
+                return
+            s.add(worker_id)
+            self._pin(oid)
+
+    def _on_borrow_added(self, p: dict) -> None:
+        self._add_borrow(p["object_id"], p.get("worker_id"))
+
+    def _on_borrow_released(self, p: dict) -> None:
+        oid = p["object_id"]
+        with self._lock:
+            s = self._borrows.get(oid)
+            if s is None or p.get("worker_id") not in s:
+                # raced ahead of the add: tombstone it (bounded — drop the
+                # oldest entries past 10k; a leaked tombstone only costs a
+                # transient borrow pin, freed when the borrower dies)
+                if len(self._early_borrow_releases) > 10_000:
+                    self._early_borrow_releases.pop(
+                        next(iter(self._early_borrow_releases))
+                    )
+                self._early_borrow_releases.setdefault(oid, set()).add(
+                    p.get("worker_id")
+                )
+                return
+            s.discard(p.get("worker_id"))
+            if not s:
+                del self._borrows[oid]
+        self._unpin(oid)
 
     def _on_ref_del(self, oid: str) -> None:
         # Runs from __del__, possibly inside a cyclic-GC pass triggered
@@ -280,6 +333,8 @@ class ClusterClient:
                 gcs.subscribe("task_result", self._on_task_result)
                 gcs.subscribe("actor_update", self._on_actor_update)
                 gcs.subscribe("nodes", self._on_nodes)
+                gcs.subscribe("borrow_added", self._on_borrow_added)
+                gcs.subscribe("borrow_released", self._on_borrow_released)
                 gcs.on_close = self._on_gcs_lost
                 reply = gcs.call("register_driver", {"driver_id": self.worker_id})
             except OSError:
@@ -348,12 +403,33 @@ class ClusterClient:
             self._register_ref(r)
 
     def _make_meta(self, spec: TaskSpec) -> dict:
-        spec_bytes = serialization.dumps({
-            "func": spec.func,
-            "args": spec.args,
-            "kwargs": spec.kwargs,
-            "method_name": spec.method_name,
-        })
+        # Refs nested inside argument values are discovered during pickling
+        # (ObjectRef construction hook fires for each __reduce__ round-trip
+        # is not needed — dumps touches every ref's __reduce__, and the
+        # worker-side loads reconstructs them under its own capture). Here
+        # they are folded into deps so the owner pins them for the task's
+        # flight and the GCS gates on their existence; marked nested=True so
+        # the executing node skips prefetching them (the task may never
+        # get() them). Reference: reference_count.cc AddNestedObjectIds.
+        nested: Dict[str, ObjectRef] = {}
+        top_level = {
+            a.id for a in list(spec.args) + list(spec.kwargs.values())
+            if isinstance(a, ObjectRef)
+        }
+
+        from ray_tpu.core.object_ref import capture_refs
+
+        def _saw(ref):
+            if ref.id not in top_level:
+                nested[ref.id] = ref
+
+        with capture_refs(_saw):
+            spec_bytes = serialization.dumps({
+                "func": spec.func,
+                "args": spec.args,
+                "kwargs": spec.kwargs,
+                "method_name": spec.method_name,
+            })
         deps = []
         for a in list(spec.args) + list(spec.kwargs.values()):
             if isinstance(a, ObjectRef):
@@ -362,6 +438,12 @@ class ClusterClient:
                     # producing task, for owner-side lineage reconstruction
                     "task": a.task_id or self._ref_index.get(a.id),
                 })
+        for ref in nested.values():
+            deps.append({
+                "id": ref.id,
+                "task": ref.task_id or self._ref_index.get(ref.id),
+                "nested": True,
+            })
         return {
             "task_id": spec.task_id,
             "name": spec.name,
@@ -479,6 +561,7 @@ class ClusterClient:
                 if p.get("status") == "ACTOR_UNREACHABLE" and \
                         self._maybe_replay_actor_call(actor_id, seq, meta, refs):
                     return
+                self._apply_borrows(p)
                 self._ingest_result(p, refs)
                 self._release_task_deps(meta["task_id"])
 
@@ -578,6 +661,7 @@ class ClusterClient:
             ObjectRef.for_task_output(task_id, i, owner=self.worker_id)
             for i in range(meta.get("num_returns", 1) if meta else len(p.get("results", [])) or 1)
         ]
+        self._apply_borrows(p)
         self._ingest_result(p, refs)
         self._release_task_deps(task_id)
 

@@ -22,7 +22,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from ray_tpu.core.config import Config
-from ray_tpu.cluster.rpc import RpcServer
+from ray_tpu.cluster.rpc import RpcClient, RpcServer
 from ray_tpu.sched.policy import make_policy_from_config
 from ray_tpu.sched.resources import NodeResourceState, ResourceSpace
 from ray_tpu.sched import bundles as bundles_mod
@@ -47,6 +47,17 @@ class GcsServer:
         self.directory: Dict[str, set] = defaultdict(set)  # object_id -> {node_id}
         self.drivers: Dict[int, dict] = {}  # conn_id -> {driver_id}
         self.task_events: deque = deque(maxlen=100000)
+        # GCS-initiated request/response clients to node daemons (the push
+        # channel is fire-and-forget; 2PC bundle prepare/commit needs acks —
+        # reference: the GCS's raylet clients in gcs_placement_group_scheduler.cc)
+        self._daemon_clients: Dict[str, RpcClient] = {}
+        # test hook: called between the prepare and commit phases of PG 2PC
+        self._pg_fault_hook = None
+        # borrow registry (reference: reference_count.cc borrower sets): the
+        # owner defers frees while a borrow exists; records here exist so a
+        # dead NODE's borrows can be released on its behalf (a dead worker's
+        # are released by its daemon)
+        self.borrows: Dict[Tuple[str, str], dict] = {}  # (oid, worker) -> {node_id, owner}
 
         # --- persistence (reference: Redis-backed gcs_table_storage for GCS
         # fault tolerance; file-backed snapshot here) ---
@@ -143,6 +154,16 @@ class GcsServer:
         for a in self.actors.values():
             if a.get("state") == "ALIVE":
                 a["state"] = "RESTARTING_GCS"
+        # a PG snapshotted mid-2PC has no finalizer in this process: park it
+        # for the retry loop. CREATED PGs get their bundle capacity reset:
+        # the running table is not persisted, so pre-crash debits would
+        # otherwise never be credited back (tasks are resubmitted anyway).
+        for pg in self.placement_groups.values():
+            if pg.get("state") == "PREPARING":
+                pg["state"] = "PENDING"
+                pg["nodes"] = None
+            elif pg.get("state") == "CREATED" and pg.get("bundle_total"):
+                pg["bundle_avail"] = [v.copy() for v in pg["bundle_total"]]
         # CREATED PG bundle allocations must be re-applied to the fresh
         # scheduler state as their nodes re-register
         for pid, pg in self.placement_groups.items():
@@ -381,15 +402,35 @@ class GcsServer:
             if info is not None:
                 if p.get("actor_creation") and p.get("status") == "FINISHED":
                     # alive actors hold their allocation for their lifetime
-                    # (released by kill_actor / node death)
+                    # (released by kill_actor / node death); a bundle-riding
+                    # actor likewise holds its bundle debit
                     self.running[f"actor-hold-{p['actor_id']}"] = info
                 else:
                     idx = self.state.node_index(info["node_id"])
                     if idx is not None:
                         self.state.release(idx, info["demand"])
+                    self._credit_pg_locked(info.get("meta"))
             for oid, size in p.get("results", []):
                 self.directory[oid].add(p["node_id"])
                 self._on_object_added(oid)
+            cross_borrow_pushes = []
+            task_owner_id = None
+            if info is not None:
+                d = self.drivers.get(info.get("owner_conn"))
+                task_owner_id = d.get("driver_id") if d else None
+            for b in p.get("borrows") or ():
+                self.borrows[(b["id"], p.get("borrow_worker"))] = {
+                    "node_id": p["node_id"], "owner": b["owner"],
+                }
+                if b["owner"] != task_owner_id:
+                    # the ref's owner isn't the task submitter: it won't see
+                    # this task_result, so tell it about the borrow directly
+                    t_conn = self._conn_for_driver_id(b["owner"])
+                    if t_conn is not None:
+                        cross_borrow_pushes.append((t_conn, {
+                            "object_id": b["id"],
+                            "worker_id": p.get("borrow_worker"),
+                        }))
             self.task_events.append(
                 {k: p.get(k) for k in ("task_id", "node_id", "status", "name",
                                        "start", "end", "actor_id")}
@@ -411,6 +452,7 @@ class GcsServer:
                                 idx = self.state.node_index(hold["node_id"])
                                 if idx is not None:
                                     self.state.release(idx, hold["demand"])
+                                self._credit_pg_locked(hold.get("meta"))
                             kill_on_node = p["node_id"]
                         else:
                             a["state"] = "ALIVE"
@@ -426,6 +468,8 @@ class GcsServer:
                             info.get("meta", {}).get("retries_left", 0) > 0
                         a["state"] = "PENDING" if retryable else "DEAD"
             target = self._driver_conn(owner_conn)
+        for t_conn, payload in cross_borrow_pushes:
+            self._push_conn(t_conn, "borrow_added", payload)
         if kill_on_node is not None:
             self._push_to_node(
                 kill_on_node, "kill_actor", {"actor_id": p["actor_id"]}
@@ -443,6 +487,26 @@ class GcsServer:
             )
         self._kick()
         return {"ok": True}
+
+    def _credit_pg_locked(self, meta) -> None:
+        """Return a finished bundle-riding task's debit to its bundle.
+        Epoch-guarded: a debit from before the PG was reset/recreated must
+        not inflate the fresh bundle. Caller holds _lock."""
+        deb = (meta or {}).get("pg_debit")
+        if not deb:
+            return
+        pg_id, i, demand, epoch = deb
+        meta.pop("pg_debit", None)
+        pg = self.placement_groups.get(pg_id)
+        if (
+            pg is not None
+            and pg.get("state") == "CREATED"
+            and pg.get("epoch", 0) == epoch
+            and i < len(pg.get("bundle_avail") or ())
+        ):
+            pg["bundle_avail"][i] = np.minimum(
+                pg["bundle_avail"][i] + demand, pg["bundle_total"][i]
+            )
 
     def _driver_conn(self, conn_id):
         d = self.drivers.get(conn_id)
@@ -471,6 +535,57 @@ class GcsServer:
                     for nid in nodes
                 ]
             }
+
+    def rpc_register_borrows(self, p, conn):
+        """Daemon-reported borrows from an actor-call result (which bypasses
+        task_done); pool-task borrows are recorded inside rpc_task_done.
+        Every borrow is ALSO pushed to its ref's owner: the direct daemon
+        reply only reaches the call's submitter, which ignores borrows of
+        refs it doesn't own (cross-owner case). Owners dedupe, so the
+        double delivery on the same-owner path is harmless."""
+        pushes = []
+        with self._lock:
+            for b in p.get("borrows", []):
+                self.borrows[(b["id"], p["worker_id"])] = {
+                    "node_id": p["node_id"], "owner": b["owner"],
+                }
+                t_conn = self._conn_for_driver_id(b["owner"])
+                if t_conn is not None:
+                    pushes.append((t_conn, {
+                        "object_id": b["id"], "worker_id": p["worker_id"],
+                    }))
+        for t_conn, payload in pushes:
+            self._push_conn(t_conn, "borrow_added", payload)
+        return {"ok": True}
+
+    def rpc_borrow_released(self, p, conn):
+        """A borrower dropped its last reference (or its daemon is speaking
+        for a dead worker): forget the record, tell the owner."""
+        with self._lock:
+            self.borrows.pop((p["object_id"], p.get("worker_id")), None)
+            target = self._conn_for_driver_id(p.get("owner"))
+        if target is not None:
+            self._push_conn(target, "borrow_released", {
+                "object_id": p["object_id"], "worker_id": p.get("worker_id"),
+            })
+        return {"ok": True}
+
+    def _conn_for_driver_id(self, driver_id):
+        """Caller holds _lock. Owner ids are driver ids (workers register as
+        drivers too, so worker-owned refs route the same way)."""
+        if driver_id is None:
+            return None
+        for d in self.drivers.values():
+            if d.get("driver_id") == driver_id:
+                return d["conn"]
+        return None
+
+    def _push_conn(self, conn, channel, payload):
+        self.server.call_soon(
+            lambda c=conn, pl=payload: __import__("asyncio").ensure_future(
+                c.push(channel, pl)
+            )
+        )
 
     def rpc_free_objects(self, p, conn):
         with self._lock:
@@ -540,6 +655,7 @@ class GcsServer:
             idx = self.state.node_index(info["node_id"])
             if idx is not None and self.state.alive[idx]:
                 self.state.release(idx, info["demand"])
+            self._credit_pg_locked(info.get("meta"))
         meta = a.get("creation_meta")
         max_restarts = a.get("max_restarts", 0)
         budget_left = max_restarts == -1 or a.get("restarts", 0) < max_restarts
@@ -567,6 +683,7 @@ class GcsServer:
                 idx = self.state.node_index(info["node_id"])
                 if idx is not None:
                     self.state.release(idx, info["demand"])
+                self._credit_pg_locked(info.get("meta"))
         if nid:
             self._push_to_node(nid, "kill_actor", {"actor_id": p["actor_id"]})
         self.server.broadcast("actor_update", {"actor_id": p["actor_id"], "state": "DEAD"})
@@ -680,46 +797,169 @@ class GcsServer:
 
     # ------------------------------------------------------- placement groups
 
+    def _daemon_client(self, node_id: str) -> Optional[RpcClient]:
+        with self._lock:
+            n = self.nodes.get(node_id)
+            if not n or not n["alive"]:
+                return None
+            c = self._daemon_clients.get(node_id)
+            if c is not None and not c._closed:
+                return c
+            addr, port = n["addr"], n["port"]
+        try:
+            c = RpcClient(addr, port)
+        except OSError:
+            return None
+        with self._lock:
+            self._daemon_clients[node_id] = c
+        return c
+
     def rpc_create_placement_group(self, p, conn):
-        """2-phase commit against node daemons (reference:
-        gcs_placement_group_scheduler.cc Prepare/CommitBundleResources)."""
+        """Real 2-phase commit against node daemons (reference:
+        gcs_placement_group_scheduler.cc Prepare/Commit/ReturnBundleResources):
+        pack -> PREPARING (resources tentatively held) -> prepare RPC on every
+        chosen daemon -> commit RPCs only if ALL prepares ack -> CREATED.
+        Any failure returns the held resources and parks the PG PENDING for
+        the retry loop. Blocking network phases run off the event loop."""
+        return self.server.loop.run_in_executor(
+            None, lambda: self._create_pg_blocking(p)
+        )
+
+    def _create_pg_blocking(self, p):
         pg_id = p["pg_id"]
         bundles = p["bundles"]  # list of {resource: amount}
         strategy = p.get("strategy", "PACK")
         with self._lock:
-            mat = np.stack([self.space.vector(b) for b in bundles])
-            nodes_idx, new_avail = bundles_mod.schedule_bundles(
-                self.state.available, self.state.total, self.state.alive,
-                mat, strategy=strategy,
-            )
-            if nodes_idx is None:
-                self.placement_groups[pg_id] = {
-                    "pg_id": pg_id, "state": "PENDING", "bundles": bundles,
-                    "strategy": strategy, "nodes": None,
+            prev = self.placement_groups.get(pg_id)
+            if prev is not None and prev.get("state") in (
+                "PREPARING", "CREATED"
+            ):
+                # duplicate create (client retry racing the PENDING-retry
+                # loop): staging again would double-debit the nodes
+                return {
+                    "ok": prev["state"] == "CREATED",
+                    "state": prev["state"],
+                    "nodes": prev.get("nodes"),
                 }
-                return {"ok": False, "state": "PENDING"}
-            self.state.replace_available(new_avail)
-            node_ids = [self.state.node_ids[i] for i in nodes_idx]
+            staged = self._stage_pg_locked(pg_id, bundles, strategy)
+        if staged is None:
+            return {"ok": False, "state": "PENDING"}
+        node_ids = staged
+        if self._finalize_pg(pg_id, bundles, node_ids):
+            return {"ok": True, "state": "CREATED", "nodes": node_ids}
+        return {"ok": False, "state": "PENDING"}
+
+    def _stage_pg_locked(self, pg_id, bundles, strategy):
+        """Pack + tentatively allocate + mark PREPARING. Caller holds _lock.
+        Returns node_ids, or None when infeasible right now (PG parked
+        PENDING)."""
+        mat = np.stack([self.space.vector(b) for b in bundles])
+        nodes_idx, new_avail = bundles_mod.schedule_bundles(
+            self.state.available, self.state.total, self.state.alive,
+            mat, strategy=strategy,
+        )
+        if nodes_idx is None:
             self.placement_groups[pg_id] = {
-                "pg_id": pg_id, "state": "CREATED", "bundles": bundles,
-                "strategy": strategy, "nodes": node_ids,
+                "pg_id": pg_id, "state": "PENDING", "bundles": bundles,
+                "strategy": strategy, "nodes": None,
+                "epoch": self.placement_groups.get(pg_id, {}).get("epoch", 0),
             }
-        # phase 2: commit bundle reservations on daemons (best-effort v1;
-        # resources are authoritative here, daemons just learn the mapping)
+            return None
+        self.state.replace_available(new_avail)
+        node_ids = [self.state.node_ids[i] for i in nodes_idx]
+        prev = self.placement_groups.get(pg_id, {})
+        self.placement_groups[pg_id] = {
+            "pg_id": pg_id, "state": "PREPARING", "bundles": bundles,
+            "strategy": strategy, "nodes": node_ids,
+            "epoch": prev.get("epoch", 0),
+        }
+        return node_ids
+
+    def _finalize_pg(self, pg_id, bundles, node_ids) -> bool:
+        """Run prepare/commit against the daemons; transition the PG. Never
+        called under _lock (network). Returns True when CREATED."""
+        ok = self._pg_phase_all("prepare_bundle", pg_id, bundles, node_ids)
+        if self._pg_fault_hook is not None:
+            try:
+                self._pg_fault_hook(pg_id)
+            except Exception:
+                traceback.print_exc()
+        if ok:
+            ok = self._pg_phase_all("commit_bundle", pg_id, bundles, node_ids)
+        with self._lock:
+            pg = self.placement_groups.get(pg_id)
+            if pg is None or pg.get("state") != "PREPARING":
+                # removed or reset (node death) while we were out; whoever
+                # changed the state owned the resource bookkeeping
+                return False
+            if ok:
+                pg["state"] = "CREATED"
+                pg["epoch"] = pg.get("epoch", 0) + 1
+                # per-bundle capacity accounting: tasks riding a bundle debit
+                # it (reference: placement_group_resource_manager.cc minting
+                # CPU_group_<pgid> resources that bundle tasks consume)
+                pg["bundle_total"] = [self.space.vector(b) for b in bundles]
+                pg["bundle_avail"] = [
+                    self.space.vector(b).copy() for b in bundles
+                ]
+                return True
+            # prepare or commit failed: return the held resources, park
+            self._release_pg_allocations_locked(pg)
+            pg["state"] = "PENDING"
+            pg["nodes"] = None
         for b_idx, nid in enumerate(node_ids):
-            self._push_to_node(nid, "commit_bundle", {
-                "pg_id": pg_id, "bundle_index": b_idx, "resources": bundles[b_idx],
+            self._push_to_node(nid, "return_bundle", {
+                "pg_id": pg_id, "bundle_index": b_idx,
             })
-        return {"ok": True, "state": "CREATED", "nodes": node_ids}
+        return False
+
+    def _pg_phase_all(self, method, pg_id, bundles, node_ids) -> bool:
+        """Fan one 2PC phase (prepare_bundle / commit_bundle) out to every
+        chosen daemon; True only when every daemon acks."""
+        futs = []
+        for b_idx, nid in enumerate(node_ids):
+            c = self._daemon_client(nid)
+            if c is None:
+                return False
+            try:
+                futs.append(c.call_async(method, {
+                    "pg_id": pg_id, "bundle_index": b_idx,
+                    "resources": bundles[b_idx],
+                }))
+            except Exception:  # noqa: BLE001
+                return False
+        for f in futs:
+            try:
+                if not (f.result(timeout=10.0) or {}).get("ok"):
+                    return False
+            except Exception:  # noqa: BLE001
+                return False
+        return True
+
+    def _release_pg_allocations_locked(self, pg, skip_node=None):
+        """Return a staged/created PG's node allocations. Caller holds
+        _lock. Rows of dead nodes are already zeroed by remove_node."""
+        for b, nid in zip(pg.get("bundles") or (), pg.get("nodes") or ()):
+            if nid == skip_node:
+                continue
+            idx = self.state.node_index(nid)
+            if idx is not None and self.state.alive[idx]:
+                self.state.release(idx, self.space.vector(b))
 
     def rpc_remove_placement_group(self, p, conn):
         with self._lock:
             pg = self.placement_groups.pop(p["pg_id"], None)
-            if pg and pg.get("nodes"):
-                for b, nid in zip(pg["bundles"], pg["nodes"]):
-                    idx = self.state.node_index(nid)
-                    if idx is not None and self.state.alive[idx]:
-                        self.state.release(idx, self.space.vector(b))
+            if pg and pg.get("nodes") and pg.get("state") in (
+                "CREATED", "PREPARING"
+            ):
+                self._release_pg_allocations_locked(pg)
+                nodes = list(pg["nodes"])
+            else:
+                nodes = []
+        for b_idx, nid in enumerate(nodes):
+            self._push_to_node(nid, "return_bundle", {
+                "pg_id": p["pg_id"], "bundle_index": b_idx,
+            })
         self._kick()
         return {"ok": True}
 
@@ -749,9 +989,17 @@ class GcsServer:
     def _schedule_round(self):
         """Reference hot path reformulated: the whole queue -> one batched
         kernel call -> dispatch pushes to daemons."""
+        pg_work: List[tuple] = []
         with self._lock:
             if not self.pending:
-                self._retry_pending_pgs()
+                pg_work = self._retry_pending_pgs_locked()
+        if not pg_work and not self.pending:
+            return
+        if pg_work:
+            self._spawn_pg_finalizers(pg_work)
+            return
+        with self._lock:
+            if not self.pending:
                 return
             batch = list(self.pending)
             self.pending.clear()
@@ -829,8 +1077,8 @@ class GcsServer:
                     leftovers.append(t)
 
             # retry PENDING placement groups now that resources may have
-            # freed up (reference: SchedulePendingPlacementGroups loop)
-            self._retry_pending_pgs()
+            # freed up; staged here, 2PC-finalized after the lock drops
+            pg_work = self._retry_pending_pgs_locked()
 
             self.pending.extend(leftovers)
             for t, node_idx, demand in dispatches:
@@ -850,6 +1098,7 @@ class GcsServer:
             to_push = [
                 (self.running[t["task_id"]]["node_id"], t) for t, _, _ in dispatches
             ]
+        self._spawn_pg_finalizers(pg_work)
         for node_id, t in to_push:
             self._push_to_node(node_id, "exec_task", t)
         for t, reason in failed:
@@ -903,39 +1152,65 @@ class GcsServer:
             if pg["state"] != "CREATED":
                 return ("requeue", None)
             b_idx = strat.get("bundle_index", -1)
-            candidates = (
-                [pg["nodes"][b_idx]] if 0 <= b_idx < len(pg["nodes"]) else pg["nodes"]
+            indices = (
+                [b_idx] if 0 <= b_idx < len(pg["nodes"])
+                else range(len(pg["nodes"]))
             )
-            for nid in candidates:
+            # Bundle-riding tasks debit the BUNDLE's capacity, not the node's
+            # (the bundle already holds the node resources) — reference:
+            # placement_group_resource_manager.cc's CPU_group_<pgid>
+            # resources. A task over any bundle's total can never run; one
+            # over current avail waits for running bundle tasks to finish.
+            fits_some_total = False
+            for i in indices:
+                nid = pg["nodes"][i]
                 idx = self.state.node_index(nid)
-                # PG bundles already hold their resources; task rides inside
-                # the bundle reservation, so no extra allocation (v1 model).
-                if idx is not None and self.state.alive[idx]:
-                    return ("dispatch", (t, idx, self.space.vector({})))
+                if idx is None or not self.state.alive[idx]:
+                    continue
+                total_i = pg["bundle_total"][i]
+                avail_i = pg["bundle_avail"][i]
+                if np.all(total_i + 1e-4 >= demand):
+                    fits_some_total = True
+                    if np.all(avail_i + 1e-4 >= demand):
+                        pg["bundle_avail"][i] = np.maximum(
+                            avail_i - demand, 0.0
+                        )
+                        t["pg_debit"] = (
+                            pg["pg_id"], i, demand, pg.get("epoch", 0)
+                        )
+                        return ("dispatch", (t, idx, self.space.vector({})))
+            if not fits_some_total and any(
+                self.state.node_index(pg["nodes"][i]) is not None
+                for i in indices
+            ):
+                return ("fail",
+                        "task demand exceeds every candidate bundle's "
+                        "capacity in placement group "
+                        f"{strat.get('placement_group_id')}")
             return ("requeue", None)
         return ("requeue", None)
 
-    def _retry_pending_pgs(self):
-        """Called under self._lock from the scheduler round."""
-        for pg_id, pg in self.placement_groups.items():
+    def _retry_pending_pgs_locked(self) -> List[tuple]:
+        """Stage every PENDING PG that now fits (caller holds _lock).
+        Returns [(pg_id, bundles, node_ids)] for off-lock 2PC finalization
+        (reference: SchedulePendingPlacementGroups loop)."""
+        staged = []
+        for pg_id, pg in list(self.placement_groups.items()):
             if pg["state"] != "PENDING":
                 continue
-            mat = np.stack([self.space.vector(b) for b in pg["bundles"]])
-            nodes_idx, new_avail = bundles_mod.schedule_bundles(
-                self.state.available, self.state.total, self.state.alive,
-                mat, strategy=pg["strategy"],
+            node_ids = self._stage_pg_locked(
+                pg_id, pg["bundles"], pg["strategy"]
             )
-            if nodes_idx is None:
-                continue
-            self.state.replace_available(new_avail)
-            node_ids = [self.state.node_ids[i] for i in nodes_idx]
-            pg["state"] = "CREATED"
-            pg["nodes"] = node_ids
-            for b_idx, nid in enumerate(node_ids):
-                self._push_to_node(nid, "commit_bundle", {
-                    "pg_id": pg_id, "bundle_index": b_idx,
-                    "resources": pg["bundles"][b_idx],
-                })
+            if node_ids is not None:
+                staged.append((pg_id, pg["bundles"], node_ids))
+        return staged
+
+    def _spawn_pg_finalizers(self, work: List[tuple]) -> None:
+        for pg_id, bundles, node_ids in work:
+            threading.Thread(
+                target=self._finalize_pg, args=(pg_id, bundles, node_ids),
+                daemon=True, name=f"pg-2pc-{pg_id[:8]}",
+            ).start()
 
     def _push_to_node(self, node_id: str, channel: str, data):
         with self._lock:
@@ -1028,6 +1303,33 @@ class GcsServer:
                     for oid in w["missing"]:
                         self.dep_waiters.get(oid, set()).discard(tid)
                     deps_lost.append((w["meta"], lost))
+            # PGs with a bundle on the dead node lose their gang guarantee:
+            # return surviving nodes' allocations and park them PENDING for
+            # re-packing (reference: gcs_placement_group_manager.cc
+            # rescheduling on node removal; covers mid-commit death too —
+            # the 2PC finalizer sees state != PREPARING and stands down)
+            pg_returns = []  # (survivor_node, pg_id, bundle_index)
+            for pg in self.placement_groups.values():
+                if (
+                    pg.get("nodes")
+                    and node_id in pg["nodes"]
+                    and pg.get("state") in ("CREATED", "PREPARING")
+                ):
+                    self._release_pg_allocations_locked(pg, skip_node=node_id)
+                    for b_idx, nid in enumerate(pg["nodes"]):
+                        if nid != node_id:
+                            pg_returns.append((nid, pg["pg_id"], b_idx))
+                    pg["state"] = "PENDING"
+                    pg["nodes"] = None
+            # the dead node's borrows are released on its behalf, else owners
+            # defer those frees forever
+            borrow_releases = []
+            for (oid, wid), rec in list(self.borrows.items()):
+                if rec["node_id"] == node_id:
+                    del self.borrows[(oid, wid)]
+                    target = self._conn_for_driver_id(rec.get("owner"))
+                    if target is not None:
+                        borrow_releases.append((target, oid, wid))
             dead_actors = [
                 a for a in self.actors.values()
                 if a["node_id"] == node_id and a["state"] in ("ALIVE", "STARTING")
@@ -1065,6 +1367,14 @@ class GcsServer:
                 )
         for meta, lost in deps_lost:
             self._push_deps_lost(meta, lost)
+        for nid, pg_id, b_idx in pg_returns:
+            self._push_to_node(nid, "return_bundle", {
+                "pg_id": pg_id, "bundle_index": b_idx,
+            })
+        for target, oid, wid in borrow_releases:
+            self._push_conn(target, "borrow_released", {
+                "object_id": oid, "worker_id": wid,
+            })
         for aid, state in actor_updates:
             self.server.broadcast(
                 "actor_update", {"actor_id": aid, "state": state}

@@ -222,6 +222,10 @@ class NodeDaemon:
         self._pull_sems: Dict[str, threading.Semaphore] = {}
         self._inflight_pulls: Dict[str, threading.Event] = {}
         self._chunks_pulled = 0
+        # borrows held by local workers: worker_id -> {oid: owner_id}; a
+        # dying worker's borrows are released on its behalf (reference:
+        # reference_count.cc removes borrower entries on worker death)
+        self._worker_borrows: Dict[str, Dict[str, str]] = {}
 
         self.server = RpcServer(
             self._handle, host=host, port=0,
@@ -252,7 +256,12 @@ class NodeDaemon:
         gcs.subscribe("exec_task", self._on_exec_task)
         gcs.subscribe("kill_actor", self._on_kill_actor)
         gcs.subscribe("free_objects", lambda p: self.store.delete(p["object_ids"]))
-        gcs.subscribe("commit_bundle", self._on_commit_bundle)
+        gcs.subscribe(
+            "return_bundle",
+            lambda p: self._bundles.pop(
+                f"{p['pg_id']}:{p['bundle_index']}", None
+            ),
+        )
         gcs.subscribe("nodes", self._on_nodes_update)
         gcs.on_close = self._on_gcs_lost
         reply = gcs.call("register_node", {
@@ -336,6 +345,16 @@ class NodeDaemon:
                 self._idle.remove(worker_id)
             except ValueError:
                 pass
+        # release the dead worker's borrows on its behalf, else the owners
+        # defer frees forever
+        for oid, owner in self._worker_borrows.pop(worker_id, {}).items():
+            try:
+                self.gcs.call_async("borrow_released", {
+                    "object_id": oid, "owner": owner,
+                    "worker_id": worker_id, "node_id": self.node_id,
+                })
+            except Exception:  # noqa: BLE001
+                pass
         if w and w.current_task:
             # worker crashed mid-task -> report failure (reference:
             # NodeManager worker death handling -> task failure)
@@ -393,6 +412,10 @@ class NodeDaemon:
             for oid, _size in p["result_shm"]:
                 self.store.note(oid)
         worker_id = conn.meta.get("worker_id")
+        if p.get("borrows") and worker_id:
+            held = self._worker_borrows.setdefault(worker_id, {})
+            for b in p["borrows"]:
+                held[b["id"]] = b["owner"]
         # actor calls are tracked by task id (several can be in flight on one
         # worker); pool tasks by the worker's current_task slot
         t = self._actor_tasks.pop(p["task_id"], None)
@@ -414,6 +437,7 @@ class NodeDaemon:
                 t, status=p.get("status", "FINISHED"), error=p.get("error"),
                 results=results,
                 start=p.get("start"), end=p.get("end"),
+                borrows=p.get("borrows"), borrow_worker=worker_id,
             )
         self._pump()
         return {"ok": True}
@@ -458,6 +482,21 @@ class NodeDaemon:
                 p["object_id"], int(p["offset"]), int(p["length"])
             ),
         )
+
+    def rpc_borrow_released(self, p, conn):
+        """Worker notify: its last local reference to a borrowed object is
+        gone. Relay to the GCS, which routes it to the owner."""
+        worker_id = p.get("worker_id") or conn.meta.get("worker_id")
+        held = self._worker_borrows.get(worker_id or "", {})
+        held.pop(p["object_id"], None)
+        try:
+            self.gcs.call_async("borrow_released", {
+                "object_id": p["object_id"], "owner": p.get("owner"),
+                "worker_id": worker_id, "node_id": self.node_id,
+            })
+        except Exception:  # noqa: BLE001
+            pass
+        return {"ok": True}
 
     def rpc_make_room(self, p, conn):
         """Attached writer (worker/driver) hit StoreFullError: spill LRU
@@ -512,9 +551,12 @@ class NodeDaemon:
     # --------------------------------------------------------- task dispatch
 
     def _on_exec_task(self, t: dict):
+        # nested deps (refs inside arg values) are pinned/gated but NOT
+        # prefetched — the task may never get() them, and a worker that does
+        # resolves them through the normal pull path on demand
         missing = [
             d["id"] for d in t.get("deps") or ()
-            if not self.store.contains(d["id"])
+            if not d.get("nested") and not self.store.contains(d["id"])
         ]
         if missing:
             # pull args into the local store FIRST; the task reaches a
@@ -614,7 +656,8 @@ class NodeDaemon:
         )
 
     def _report_done(self, t: dict, status: str, error=None, results=None,
-                     start=None, end=None, lost=None):
+                     start=None, end=None, lost=None, borrows=None,
+                     borrow_worker=None):
         task_id = t["task_id"]
         fut = self._pending_rpc.pop(task_id, None)
         payload = {
@@ -630,7 +673,19 @@ class NodeDaemon:
             "owner_conn": t.get("owner_conn"),
             "start": start,
             "end": end,
+            "borrows": borrows or [],
+            "borrow_worker": borrow_worker,
         }
+        if borrows and fut is not None:
+            # actor-call results bypass the GCS; register the borrows there
+            # explicitly so node-death cleanup still covers them
+            try:
+                self.gcs.call_async("register_borrows", {
+                    "node_id": self.node_id, "worker_id": borrow_worker,
+                    "borrows": borrows,
+                })
+            except Exception:  # noqa: BLE001
+                pass
         # inline small results so the driver skips the fetch round trip
         inline = {}
         budget = self.config.max_direct_call_object_size
@@ -843,11 +898,28 @@ class NodeDaemon:
             except OSError:
                 pass
 
-    def _on_commit_bundle(self, p):
-        # Reference: placement_group_resource_manager.cc mints
-        # CPU_group_<pgid> resources; v1 records the reservation (resource
-        # authority is the GCS view).
-        self._bundles[f"{p['pg_id']}:{p['bundle_index']}"] = p
+    # --- 2PC bundle protocol, GCS-initiated (reference:
+    # placement_group_resource_manager.cc Prepare/Commit/ReturnBundle;
+    # resource authority stays in the GCS view — daemons record the
+    # reservation mapping, the analog of minting CPU_group_<pgid>) ---
+
+    def rpc_prepare_bundle(self, p, conn):
+        if self._stopped:
+            return {"ok": False, "error": "daemon stopping"}
+        key = f"{p['pg_id']}:{p['bundle_index']}"
+        self._bundles[key] = {**p, "state": "PREPARED"}
+        return {"ok": True}
+
+    def rpc_commit_bundle(self, p, conn):
+        key = f"{p['pg_id']}:{p['bundle_index']}"
+        ent = self._bundles.get(key)
+        if ent is None or self._stopped:
+            # commit without a surviving prepare (daemon restarted between
+            # phases): refuse so the GCS returns the bundle and re-packs
+            return {"ok": False, "error": "no prepared bundle"}
+        ent["state"] = "COMMITTED"
+        return {"ok": True}
+
 
     def _on_nodes_update(self, snapshot):
         self._nodes_snapshot = snapshot

@@ -14,16 +14,73 @@ from __future__ import annotations
 
 import os
 import queue
+import threading
 import time
 import traceback
 
 from ray_tpu.core import serialization
-from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.object_ref import ObjectRef, capture_refs
 from ray_tpu.cluster.rpc import RpcClient
 
 _actor_instances = {}
 _actor_concurrency = {}
 _shm = None  # ShmClientStore when the daemon exposes a segment
+
+# ---- borrower accounting (reference: reference_count.cc AddBorrowedObject) --
+# Every ObjectRef deserialized out of task args is counted here. A ref still
+# alive when its task finishes (stashed in actor state / a global) makes this
+# worker a BORROWER: the daemon/GCS relay that to the owner, which defers
+# auto-free until the borrow is released (the ref's count here hits zero) or
+# this worker dies.
+_borrowed: dict = {}  # oid -> {"count": int, "reported": bool, "owner": str}
+_borrow_lock = threading.Lock()
+_daemon_client: RpcClient = None  # set in main()
+
+
+def _on_borrow_ref(ref: ObjectRef):
+    """Capture hook: a ref was deserialized from task args on this thread."""
+    if ref.owner is None:
+        return  # unroutable: no owner to defer the free
+    with _borrow_lock:
+        ent = _borrowed.setdefault(
+            ref.id, {"count": 0, "reported": False, "owner": ref.owner}
+        )
+        ent["count"] += 1
+    ref._register(_on_borrow_del)
+
+
+def _on_borrow_del(oid: str):
+    with _borrow_lock:
+        ent = _borrowed.get(oid)
+        if ent is None:
+            return
+        ent["count"] -= 1
+        if ent["count"] > 0:
+            return
+        del _borrowed[oid]
+        reported = ent["reported"]
+    if reported and _daemon_client is not None:
+        try:
+            _daemon_client.notify("borrow_released", {
+                "object_id": oid, "owner": ent["owner"],
+                "worker_id": os.environ.get("RAY_TPU_WORKER_ID"),
+            })
+        except Exception:  # noqa: BLE001 - daemon gone; it cleans up for us
+            pass
+
+
+def _collect_borrows(task_refs: list) -> list:
+    """Called after the task's own references are dropped: any arg ref still
+    counted is stashed beyond the task — report it (once) as borrowed."""
+    out = []
+    with _borrow_lock:
+        for oid in task_refs:
+            ent = _borrowed.get(oid)
+            if ent is None or ent["count"] <= 0 or ent["reported"]:
+                continue
+            ent["reported"] = True
+            out.append({"id": oid, "owner": ent["owner"]})
+    return out
 
 
 def _attach_shm():
@@ -83,14 +140,25 @@ def _execute(client: RpcClient, t: dict):
     ]
     # actor method calls derive output ids the same way on the driver side
     pins = []
+    task_arg_refs: list = []  # oids of refs deserialized for THIS task
     try:
-        spec = serialization.loads(t["spec_bytes"])
-        is_actor_task = bool(t.get("actor_creation") or t.get("actor_id"))
-        arg_pins = None if is_actor_task else pins
-        args = tuple(_resolve(client, a, arg_pins) for a in spec["args"])
-        kwargs = {
-            k: _resolve(client, v, arg_pins) for k, v in spec["kwargs"].items()
-        }
+        # capture every ref that materializes while unpacking args (top-level
+        # and nested, including refs inside fetched values) — candidates for
+        # borrow reporting if user code stashes them past the task
+        def _saw_ref(r):
+            if r.owner is not None:
+                task_arg_refs.append(r.id)
+            _on_borrow_ref(r)
+
+        with capture_refs(_saw_ref):
+            spec = serialization.loads(t["spec_bytes"])
+            is_actor_task = bool(t.get("actor_creation") or t.get("actor_id"))
+            arg_pins = None if is_actor_task else pins
+            args = tuple(_resolve(client, a, arg_pins) for a in spec["args"])
+            kwargs = {
+                k: _resolve(client, v, arg_pins)
+                for k, v in spec["kwargs"].items()
+            }
         if t.get("actor_creation"):
             cls = spec["func"]
             _actor_instances[t["actor_id"]] = cls(*args, **kwargs)
@@ -112,6 +180,9 @@ def _execute(client: RpcClient, t: dict):
             )
         packed = [(oid, _pack_value(v)) for oid, v in zip(out_ids, values)]
         status, error = "FINISHED", None
+        # drop the task's own references so only genuinely stashed arg refs
+        # (actor state, globals) survive into the borrow check below
+        del spec, args, kwargs, values
     except BaseException as e:  # noqa: BLE001 - worker must survive user errors
         tb = traceback.format_exc()
         from ray_tpu.core.exceptions import TaskError
@@ -119,6 +190,10 @@ def _execute(client: RpcClient, t: dict):
         err = TaskError(f"task {t.get('name') or task_id} failed: {e!r}", tb)
         packed = [(oid, _pack_value(err, is_exception=True)) for oid in out_ids]
         status, error = "FAILED", f"{e!r}"
+        # the frame still binds whatever the try block reached; clear so
+        # arg refs aren't miscounted as stashed below
+        spec = args = kwargs = values = None
+    borrows = _collect_borrows(task_arg_refs) if task_arg_refs else []
     # Results go straight into shm (create+seal, zero daemon copies); the
     # RPC carries only (oid, size). Fallback: bytes in the RPC frame.
     try:
@@ -134,6 +209,7 @@ def _execute(client: RpcClient, t: dict):
             "error": error,
             "result_payloads": payloads,
             "result_shm": shm_results,
+            "borrows": borrows,
             "start": start,
             "end": time.time(),
         }, timeout=120.0)
@@ -147,10 +223,12 @@ def _execute(client: RpcClient, t: dict):
 
 
 def main():  # pragma: no cover - runs as a subprocess
+    global _daemon_client
     host = os.environ["RAY_TPU_DAEMON_HOST"]
     port = int(os.environ["RAY_TPU_DAEMON_PORT"])
     worker_id = os.environ["RAY_TPU_WORKER_ID"]
     client = RpcClient(host, port, timeout=120.0)
+    _daemon_client = client
     _attach_shm()
     tasks: "queue.Queue[dict]" = queue.Queue()
     client.subscribe("run_task", tasks.put)

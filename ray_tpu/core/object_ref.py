@@ -8,13 +8,35 @@ ObjectID::FromIndex).
 
 from __future__ import annotations
 
+import contextlib
 import hashlib
 import os
+import threading
 from typing import Optional
 
 
 def _rand_hex(n: int = 16) -> str:
     return os.urandom(n).hex()
+
+
+# Thread-local construction hook: while active, every ObjectRef built on this
+# thread (including via unpickling) is reported to the callback. This is how
+# refs NESTED inside values are discovered — at serialize time on the owner
+# (so they join the task's deps and get pinned) and at deserialize time in
+# the worker (so the worker registers as a borrower). Reference analog: the
+# serialization hooks feeding reference_count.cc's AddNestedObjectIds /
+# AddBorrowedObject.
+_capture = threading.local()
+
+
+@contextlib.contextmanager
+def capture_refs(cb):
+    prev = getattr(_capture, "cb", None)
+    _capture.cb = cb
+    try:
+        yield
+    finally:
+        _capture.cb = prev
 
 
 class ObjectRef:
@@ -26,6 +48,9 @@ class ObjectRef:
         self.owner = owner  # owner worker/driver id (ownership-based directory)
         self.task_id = task_id  # creating task, for lineage reconstruction
         self._hash = hash(self.id)
+        cb = getattr(_capture, "cb", None)
+        if cb is not None:
+            cb(self)
 
     def _register(self, on_del) -> bool:
         """Runtime hook: count this instance toward the owner's local
@@ -63,4 +88,10 @@ class ObjectRef:
         return f"ObjectRef({self.id[:16]})"
 
     def __reduce__(self):
+        # fires the capture hook at SERIALIZE time too, so an owner pickling
+        # a value discovers the refs nested in it (deserialize-side capture
+        # goes through __init__)
+        cb = getattr(_capture, "cb", None)
+        if cb is not None:
+            cb(self)
         return (ObjectRef, (self.id, self.owner, self.task_id))
