@@ -109,6 +109,10 @@ class ClusterClient:
         self._daemon_conns: Dict[str, RpcClient] = {}
         self._shm_conns: Dict[str, Any] = {}  # node_id -> ShmClientStore|False
         self._reconstructing: set = set()  # producer task_ids being re-run
+        # working-dir packaging memo: realpath -> KV key (one zip + upload
+        # per directory per driver; mutating the dir mid-run is not picked
+        # up, matching the reference's upload-once semantics)
+        self._uploaded_rtenvs: Dict[str, str] = {}
         # ---- distributed reference counting (owner side) ----
         # Semantics from reference_count.cc (owned refs, task-duration arg
         # pins, lineage pinned while outputs live, BORROWS), not its
@@ -136,8 +140,15 @@ class ClusterClient:
         self.gcs.subscribe("nodes", self._on_nodes)
         self.gcs.subscribe("borrow_added", self._on_borrow_added)
         self.gcs.subscribe("borrow_released", self._on_borrow_released)
+        self.gcs.subscribe("worker_logs", self._on_worker_logs)
         self.gcs.on_close = self._on_gcs_lost
-        reply = self.gcs.call("register_driver", {"driver_id": self.worker_id})
+        # workers embed a ClusterClient too; they register flagged so the
+        # GCS excludes them from worker-log fanout (a worker printing
+        # received logs would echo them back through its own log pump)
+        self._is_worker_client = "RAY_TPU_WORKER_ID" in __import__("os").environ
+        reply = self.gcs.call("register_driver", {
+            "driver_id": self.worker_id, "worker": self._is_worker_client,
+        })
         self._nodes: Dict[str, dict] = reply["nodes"]
         self._put_rr = 0
         self._gc_thread = threading.Thread(
@@ -167,6 +178,15 @@ class ClusterClient:
             free = rc[0] <= 0 and rc[1] <= 0
         if free:
             self._queue_free(oid)
+
+    def _on_worker_logs(self, p: dict) -> None:
+        """Worker stdout/stderr reaching the driver, reference-style
+        '(pid=..., node=...)' prefixed (log_monitor.py's output format)."""
+        if not self.config.log_to_driver:
+            return
+        prefix = f"(pid={p.get('pid')}, node={str(p.get('node_id'))[:12]})"
+        for line in p.get("lines") or ():
+            print(f"{prefix} {line}", flush=True)
 
     def _apply_borrows(self, p: dict) -> None:
         """Borrows reported in a task result: pin each (oid, borrower) pair
@@ -335,8 +355,12 @@ class ClusterClient:
                 gcs.subscribe("nodes", self._on_nodes)
                 gcs.subscribe("borrow_added", self._on_borrow_added)
                 gcs.subscribe("borrow_released", self._on_borrow_released)
+                gcs.subscribe("worker_logs", self._on_worker_logs)
                 gcs.on_close = self._on_gcs_lost
-                reply = gcs.call("register_driver", {"driver_id": self.worker_id})
+                reply = gcs.call("register_driver", {
+                    "driver_id": self.worker_id,
+                    "worker": self._is_worker_client,
+                })
             except OSError:
                 continue
             with self._lock:
@@ -447,6 +471,7 @@ class ClusterClient:
         return {
             "task_id": spec.task_id,
             "name": spec.name,
+            "runtime_env": self._process_runtime_env(spec.runtime_env),
             "class_key": spec.scheduling_class(),
             "resources": dict(spec.resources),
             "deps": deps,
@@ -463,8 +488,34 @@ class ClusterClient:
                 "soft": spec.strategy.soft,
                 "placement_group_id": spec.strategy.placement_group_id,
                 "bundle_index": spec.strategy.bundle_index,
+                "labels_hard": spec.strategy.labels_hard,
+                "labels_soft": spec.strategy.labels_soft,
             },
         }
+
+    def _process_runtime_env(self, runtime_env) -> Optional[dict]:
+        """Turn a validated runtime_env into its wire form: working_dir is
+        zipped and stored ONCE in the GCS KV under its content hash
+        (reference: runtime_env working_dir upload to GCS storage)."""
+        if not runtime_env:
+            return None
+        from ray_tpu.core import runtime_env as rtenv
+
+        out = {}
+        if runtime_env.get("env_vars"):
+            out["env_vars"] = dict(runtime_env["env_vars"])
+        wd = runtime_env.get("working_dir")
+        if wd:
+            import os as _os
+
+            real = _os.path.realpath(wd)
+            key = self._uploaded_rtenvs.get(real)
+            if key is None:
+                key, data = rtenv.package_working_dir(wd)
+                self.kv_put(key, data)
+                self._uploaded_rtenvs[real] = key
+            out["working_dir_key"] = key
+        return out or None
 
     # ------------------------------------------------------------ actor path
 

@@ -260,7 +260,10 @@ class GcsServer:
 
     def rpc_register_driver(self, p, conn):
         with self._lock:
-            self.drivers[conn.conn_id] = {"driver_id": p["driver_id"], "conn": conn}
+            self.drivers[conn.conn_id] = {
+                "driver_id": p["driver_id"], "conn": conn,
+                "worker": bool(p.get("worker")),
+            }
             conn.meta["driver_id"] = p["driver_id"]
             self.jobs[p["driver_id"]] = {
                 "job_id": p["driver_id"], "start": time.time(), "state": "RUNNING",
@@ -535,6 +538,27 @@ class GcsServer:
                     for nid in nodes
                 ]
             }
+
+    def rpc_worker_logs(self, p, conn):
+        """Fan worker output out to drivers (reference: log_monitor.py ->
+        the familiar (pid=...) prefixed driver lines). Lines tagged with an
+        owning driver go only to that driver; untagged lines (worker idle
+        chatter) go to every non-worker driver."""
+        owner = p.get("owner")
+        with self._lock:
+            driver_conn_ids = {
+                d["conn"].conn_id for d in self.drivers.values()
+                if not d.get("worker")
+                and (owner is None or d.get("driver_id") == owner)
+            }
+        if not driver_conn_ids:
+            return {"ok": True}
+        self.server.broadcast(
+            "worker_logs",
+            {k: p.get(k) for k in ("node_id", "worker_id", "pid", "lines")},
+            filter_fn=lambda c: c.conn_id in driver_conn_ids,
+        )
+        return {"ok": True}
 
     def rpc_register_borrows(self, p, conn):
         """Daemon-reported borrows from an actor-call result (which bypasses
@@ -1035,7 +1059,9 @@ class GcsServer:
                     else:
                         self._enqueue_waiting(t, missing)
                     continue
-                if t.get("strategy", {}).get("kind") in ("NODE_AFFINITY", "PLACEMENT_GROUP"):
+                if t.get("strategy", {}).get("kind") in (
+                    "NODE_AFFINITY", "PLACEMENT_GROUP", "NODE_LABEL"
+                ):
                     special.append(t)
                 else:
                     default_batch.append(t)
@@ -1144,6 +1170,8 @@ class GcsServer:
                 return ("fail", f"node {target} is dead or unknown "
                                 f"(hard NodeAffinity)")
             return ("requeue", None)
+        if strat.get("kind") == "NODE_LABEL":
+            return self._schedule_node_label(t, strat, demand)
         if strat.get("kind") == "PLACEMENT_GROUP":
             pg = self.placement_groups.get(strat.get("placement_group_id"))
             if pg is None:
@@ -1188,6 +1216,57 @@ class GcsServer:
                         "capacity in placement group "
                         f"{strat.get('placement_group_id')}")
             return ("requeue", None)
+        return ("requeue", None)
+
+    def _schedule_node_label(self, t, strat, demand) -> Tuple[str, Any]:
+        """NODE_LABEL strategy (reference: node_label_scheduling_policy.cc):
+        hard labels filter candidate nodes ({key: [allowed values]}, all keys
+        must match); soft labels prefer matching nodes among the feasible.
+        Caller holds _lock."""
+        from ray_tpu.sched import kernel_np
+
+        def matches(labels: Dict[str, str], constraints) -> bool:
+            return all(
+                labels.get(k) in vals for k, vals in (constraints or {}).items()
+            )
+
+        hard = strat.get("labels_hard") or {}
+        soft = strat.get("labels_soft") or {}
+        label_ok = np.array(
+            [matches(self.state.labels[i], hard)
+             for i in range(len(self.state.node_ids))],
+            dtype=bool,
+        )
+        if not label_ok.any():
+            # NO registered node (alive or dead) carries matching labels:
+            # fail fast instead of queuing forever. Deliberate divergence
+            # from the reference (which parks infeasible tasks with a
+            # warning) — the round-3 verdict's done-criterion asks for loud
+            # rejection of impossible label sets. A matching-but-DEAD node
+            # falls through to requeue below (it may re-register).
+            return ("fail",
+                    f"no registered node matches hard label "
+                    f"constraints {hard}")
+        hard_ok = label_ok & self.state.alive
+        feas = kernel_np.feasible_mask(
+            self.state.available, hard_ok, demand
+        )
+        if not feas.any():
+            return ("requeue", None)  # matching nodes exist but are full
+        soft_ok = np.array(
+            [matches(self.state.labels[i], soft)
+             for i in range(len(self.state.node_ids))],
+            dtype=bool,
+        )
+        pick_from = feas & soft_ok if (feas & soft_ok).any() else feas
+        score = kernel_np.node_scores(
+            self.state.available, self.state.total,
+            self.config.scheduler_spread_threshold,
+        )
+        score = np.where(pick_from, score, np.float32(np.inf))
+        idx = int(np.argmin(score))
+        if self.state.allocate(idx, demand):
+            return ("dispatch", (t, idx, demand))
         return ("requeue", None)
 
     def _retry_pending_pgs_locked(self) -> List[tuple]:

@@ -316,6 +316,9 @@ class NodeDaemon:
         env["RAY_TPU_WORKER_ID"] = worker_id
         env["RAY_TPU_NODE_ID"] = self.node_id
         env["RAY_TPU_GCS_ADDR"] = f"{self.gcs.host}:{self.gcs.port}"
+        # piped stdout would otherwise block-buffer user prints, stranding
+        # them until process exit instead of streaming to the driver
+        env["PYTHONUNBUFFERED"] = "1"
         if self.shm_name:
             env["RAY_TPU_SHM_NAME"] = self.shm_name
         env["PYTHONPATH"] = (
@@ -324,16 +327,75 @@ class NodeDaemon:
         )
         # Workers default to CPU jax so N workers don't fight over the one
         # TPU chip; tasks demanding TPU get it via RAY_TPU_WORKER_USE_TPU.
+        stream_logs = self.config.log_to_driver
+        # bufsize=0: the log pump select()s on the fd; a BufferedReader
+        # would pull several lines into userspace per readline and leave
+        # the rest invisible to select until the worker next prints
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu.cluster.worker"],
             env=env,
-            stdout=subprocess.DEVNULL if not self.config.log_to_driver else None,
-            stderr=None,
+            stdout=subprocess.PIPE if stream_logs else subprocess.DEVNULL,
+            stderr=subprocess.STDOUT if stream_logs else None,
+            bufsize=0 if stream_logs else -1,
         )
         w = _Worker(worker_id, proc)
         with self._lock:
             self.workers[worker_id] = w
+        if stream_logs:
+            # tail the worker's merged stdout/stderr and forward to the GCS,
+            # which fans lines out to drivers (reference:
+            # python/ray/_private/log_monitor.py tailing worker log files)
+            threading.Thread(
+                target=self._log_pump, args=(w,), daemon=True,
+                name=f"daemon-logpump-{worker_id[:8]}",
+            ).start()
         return w
+
+    def _log_pump(self, w: "_Worker"):
+        import select
+
+        batch: List[str] = []
+
+        def flush():
+            nonlocal batch
+            if batch:
+                # attribute the lines to the driver whose task is (or was
+                # just) running here, so other drivers' consoles don't
+                # receive them (reference: per-job log routing)
+                t = w.current_task
+                owner = (t or {}).get("owner")
+                try:
+                    self.gcs.call_async("worker_logs", {
+                        "node_id": self.node_id,
+                        "worker_id": w.worker_id,
+                        "pid": w.proc.pid,
+                        "owner": owner,
+                        "lines": batch,
+                    })
+                except Exception:  # noqa: BLE001 - gcs reconnecting
+                    pass
+                batch = []
+
+        pipe = w.proc.stdout
+        try:
+            while not self._stopped:
+                # select-with-timeout so a quiet pipe still flushes the tail
+                # of a batch (a blocking readline would strand the last
+                # lines until the worker's NEXT output)
+                ready, _, _ = select.select([pipe], [], [], 0.2)
+                if not ready:
+                    flush()
+                    continue
+                raw = pipe.readline()
+                if not raw:
+                    break  # EOF: worker exited
+                batch.append(raw.decode(errors="replace").rstrip("\n"))
+                if len(batch) >= 100:
+                    flush()
+        except (ValueError, OSError):
+            pass  # pipe closed with the worker
+        finally:
+            flush()
 
     def _on_worker_disconnect(self, conn):
         worker_id = conn.meta.get("worker_id")

@@ -131,6 +131,29 @@ def _pack_value(value, is_exception=False) -> bytes:
     return serialization.pack({"e": is_exception, "v": value})
 
 
+from ray_tpu.core import runtime_env as _rtenv_mod  # noqa: E402
+
+
+def _resolve_runtime_env(rtenv):
+    """Materialize a wire-form runtime_env: fetch + extract the working dir
+    (content-hash cached) via this worker's runtime KV client."""
+    if not rtenv:
+        return None, None
+    cwd = None
+    key = rtenv.get("working_dir_key")
+    if key:
+        from ray_tpu.core import api as _api
+
+        rt = _api._runtime
+        data = rt.kv_get(key)
+        if data is None:
+            raise RuntimeError(f"runtime_env working_dir {key} missing from KV")
+        cwd = _rtenv_mod.ensure_working_dir(
+            key, data, rt.config.session_dir_root
+        )
+    return rtenv.get("env_vars"), cwd
+
+
 def _execute(client: RpcClient, t: dict):
     task_id = t["task_id"]
     start = time.time()
@@ -159,9 +182,13 @@ def _execute(client: RpcClient, t: dict):
                 k: _resolve(client, v, arg_pins)
                 for k, v in spec["kwargs"].items()
             }
+        env_vars, env_cwd = _resolve_runtime_env(t.get("runtime_env"))
         if t.get("actor_creation"):
-            cls = spec["func"]
-            _actor_instances[t["actor_id"]] = cls(*args, **kwargs)
+            # keep=True: the dedicated actor worker owns this env for the
+            # actor's lifetime (reference: per-runtime-env worker pools)
+            with _rtenv_mod.applied(env_vars, env_cwd, keep=True):
+                cls = spec["func"]
+                _actor_instances[t["actor_id"]] = cls(*args, **kwargs)
             _actor_concurrency[t["actor_id"]] = int(t.get("max_concurrency", 1))
             values = [t["actor_id"]]
         elif t.get("actor_id"):
@@ -172,7 +199,8 @@ def _execute(client: RpcClient, t: dict):
             value = method(*args, **kwargs)
             values = [value] if num_returns == 1 else list(value)
         else:
-            value = spec["func"](*args, **kwargs)
+            with _rtenv_mod.applied(env_vars, env_cwd):
+                value = spec["func"](*args, **kwargs)
             values = [value] if num_returns == 1 else list(value)
         if len(values) != num_returns:
             raise ValueError(

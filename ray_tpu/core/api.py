@@ -135,11 +135,25 @@ def _strategy_from_options(opts: Dict[str, Any]) -> SchedulingStrategy:
     # PlacementGroupSchedulingStrategy / NodeAffinitySchedulingStrategy objects
     from ray_tpu.util.scheduling_strategies import (
         NodeAffinitySchedulingStrategy,
+        NodeLabelSchedulingStrategy,
         PlacementGroupSchedulingStrategy,
     )
 
     if isinstance(s, NodeAffinitySchedulingStrategy):
         return SchedulingStrategy(kind="NODE_AFFINITY", node_id=s.node_id, soft=s.soft)
+    if isinstance(s, NodeLabelSchedulingStrategy):
+        def _norm(d):
+            # accept "value" or ["v1", "v2"] per key
+            return {
+                k: list(v) if isinstance(v, (list, tuple, set)) else [v]
+                for k, v in (d or {}).items()
+            }
+
+        return SchedulingStrategy(
+            kind="NODE_LABEL",
+            labels_hard=_norm(s.hard),
+            labels_soft=_norm(s.soft),
+        )
     if isinstance(s, PlacementGroupSchedulingStrategy):
         pg = s.placement_group
         pg_id = pg.id if hasattr(pg, "id") else str(pg)
@@ -155,6 +169,12 @@ def _check_options(opts: Dict[str, Any]):
     bad = set(opts) - _VALID_OPTIONS
     if bad:
         raise ValueError(f"invalid @remote options: {sorted(bad)}")
+    # validate eagerly: a typo'd runtime_env key must fail at definition
+    # time, never silently no-op (reference: runtime env validation in
+    # python/ray/_private/ray_option_utils.py)
+    from ray_tpu.core import runtime_env as _rtenv
+
+    _rtenv.validate(opts.get("runtime_env"))
 
 
 # ------------------------------------------------------------ remote functions
@@ -191,6 +211,7 @@ class RemoteFunction:
             strategy=_strategy_from_options(opts),
             owner_id=rt.worker_id,
             name=opts.get("name") or getattr(self._func, "__name__", "task"),
+            runtime_env=opts.get("runtime_env"),
         )
         refs = rt.submit_task(spec)
         return refs[0] if num_returns == 1 else refs
@@ -303,6 +324,7 @@ class ActorClass:
             max_concurrency=int(opts.get("max_concurrency", 1)),
             owner_id=rt.worker_id,
             name=opts.get("name") or f"{self._cls.__name__}.__init__",
+            runtime_env=opts.get("runtime_env"),
         )
         refs = rt.submit_task(spec)
         method_meta = {}

@@ -328,7 +328,11 @@ class LocalRuntime:
         start = time.time()
         try:
             args, kwargs = self._resolve_args(spec)
-            value = spec.func(*args, **kwargs)
+            from ray_tpu.core import runtime_env as _rtenv
+
+            re = spec.runtime_env or {}
+            with _rtenv.applied(re.get("env_vars"), re.get("working_dir")):
+                value = spec.func(*args, **kwargs)
             self._store_results(spec, value)
             status = "FINISHED"
         except BaseException as e:
@@ -416,7 +420,14 @@ class LocalRuntime:
         try:
             args, kwargs = self._resolve_args(creation_spec)
             cls = creation_spec.func
-            st.instance = cls(*args, **kwargs)
+            # local mode runs actors on threads in ONE process: env applies
+            # for the constructor only (not keep=) — process-global env
+            # can't be owned by one thread-actor for its lifetime
+            from ray_tpu.core import runtime_env as _rtenv
+
+            re = creation_spec.runtime_env or {}
+            with _rtenv.applied(re.get("env_vars"), re.get("working_dir")):
+                st.instance = cls(*args, **kwargs)
             self._store_results(creation_spec, st.actor_id)
         except BaseException as e:
             tb = traceback.format_exc()

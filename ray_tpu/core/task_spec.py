@@ -24,11 +24,14 @@ class SchedulingStrategy:
     """User-facing scheduling strategies (reference:
     python/ray/util/scheduling_strategies.py)."""
 
-    kind: str = "DEFAULT"  # DEFAULT | SPREAD | NODE_AFFINITY | PLACEMENT_GROUP
+    kind: str = "DEFAULT"  # DEFAULT | SPREAD | NODE_AFFINITY | PLACEMENT_GROUP | NODE_LABEL
     node_id: Optional[str] = None
     soft: bool = False
     placement_group_id: Optional[str] = None
     bundle_index: int = -1
+    # NODE_LABEL: {label_key: [allowed values]}; hard filters, soft prefers
+    labels_hard: Optional[Dict[str, Any]] = None
+    labels_soft: Optional[Dict[str, Any]] = None
 
 
 @dataclass
@@ -48,6 +51,8 @@ class TaskSpec:
     method_name: Optional[str] = None
     max_restarts: int = 0
     max_concurrency: int = 1
+    # per-task environment (validated dict: env_vars / working_dir)
+    runtime_env: Optional[Dict[str, Any]] = None
     # bookkeeping
     owner_id: Optional[str] = None
     submitted_at: float = field(default_factory=time.time)

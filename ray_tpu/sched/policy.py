@@ -142,6 +142,34 @@ class SpreadPolicy(SchedulingPolicy):
         return assigned
 
 
+class RandomPolicy(SchedulingPolicy):
+    """Uniform-random placement over feasible nodes (reference:
+    random_scheduling_policy.cc). Seeded for reproducibility — the kernels
+    stay deterministic; randomness lives only in this policy."""
+
+    name = "random"
+
+    def __init__(self, seed: int = 0):
+        self._rng = np.random.default_rng(seed)
+
+    def schedule(self, state, demands, counts):
+        C = demands.shape[0]
+        N = len(state)
+        assigned = np.zeros((C, N), dtype=np.int32)
+        avail = state.available
+        for c in range(C):
+            d = demands[c]
+            for _ in range(int(counts[c])):
+                feas = kernel_np.feasible_mask(avail, state.alive, d)
+                if not feas.any():
+                    break
+                n = int(self._rng.choice(np.flatnonzero(feas)))
+                avail[n] = np.maximum(avail[n] - d, 0.0)
+                state.dirty_rows.add(n)
+                assigned[c, n] += 1
+        return assigned
+
+
 class NodeAffinityPolicy(SchedulingPolicy):
     """Pin to a specific node, optionally soft (reference:
     node_affinity_scheduling_policy.cc)."""
@@ -179,6 +207,7 @@ _POLICIES = {
     "hybrid": lambda **kw: HybridPolicy(backend="numpy", **kw),
     "jax_tpu": lambda **kw: HybridPolicy(backend="jax", **kw),
     "spread": lambda **kw: SpreadPolicy(),
+    "random": lambda **kw: RandomPolicy(**kw),
 }
 
 

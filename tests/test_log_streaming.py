@@ -1,0 +1,74 @@
+"""Worker stdout/stderr streaming to the driver.
+
+Reference: python/ray/_private/log_monitor.py — worker output reaches the
+driver as '(pid=..., node=...)'-prefixed lines. Here the daemon tails each
+worker's merged stdout/stderr pipe and relays batches through the GCS to
+every connected driver.
+"""
+
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster import Cluster
+
+
+@pytest.fixture
+def cluster():
+    c = Cluster()
+    c.add_node(num_cpus=2)
+    c.wait_for_nodes(1)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def test_worker_print_reaches_driver(cluster, capsys):
+    ray_tpu.init(address=cluster.address)
+
+    @ray_tpu.remote
+    def chatty():
+        print("hello-from-worker-xyzzy")
+        print("second-line-xyzzy", file=sys.stderr)
+        return 1
+
+    assert ray_tpu.get(chatty.remote(), timeout=60) == 1
+    out = ""
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        out += capsys.readouterr().out
+        if "hello-from-worker-xyzzy" in out and "second-line-xyzzy" in out:
+            break
+        time.sleep(0.2)
+    assert "hello-from-worker-xyzzy" in out, out[-2000:]
+    assert "second-line-xyzzy" in out, out[-2000:]
+    line = next(
+        ln for ln in out.splitlines() if "hello-from-worker-xyzzy" in ln
+    )
+    assert line.startswith("(pid="), line
+    assert "node=" in line, line
+
+
+def test_log_to_driver_off_suppresses(capsys):
+    from ray_tpu.core.config import Config
+
+    c = Cluster(config=Config({"log_to_driver": False}))
+    c.add_node(num_cpus=2)
+    c.wait_for_nodes(1)
+    try:
+        ray_tpu.init(address=c.address)
+
+        @ray_tpu.remote
+        def quiet():
+            print("should-not-appear-qqq")
+            return 1
+
+        assert ray_tpu.get(quiet.remote(), timeout=60) == 1
+        time.sleep(1.0)
+        out = capsys.readouterr().out
+        assert "should-not-appear-qqq" not in out
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
