@@ -1,0 +1,4 @@
+import sys; sys.path.insert(0, "/root/repo")
+import tests.conftest
+import bench
+print(bench.cluster_mode_bench())

@@ -84,6 +84,19 @@ class _ActorQueue:
                     self._cv.wait()
 
 
+def _log_rpc_failure(fut):
+    """Done-callback for fire-and-forget RPCs: surfaces server-side errors
+    that would otherwise sit unread on the discarded future."""
+    try:
+        exc = fut.exception()
+    except Exception:  # noqa: BLE001 - cancelled
+        return
+    if exc is not None:
+        import sys
+
+        print(f"[ray_tpu] async rpc failed: {exc!r}", file=sys.stderr)
+
+
 def _parse_address(address) -> Tuple[str, int]:
     if isinstance(address, tuple):
         return address
@@ -405,7 +418,13 @@ class ClusterClient:
         with self._lock:
             self._task_meta[spec.task_id] = meta
         self._track_submission(spec.task_id, meta, refs)
-        self.gcs.call("submit_task", meta)
+        # async submit: the ack carries nothing the client uses (deps-lost
+        # outcomes also arrive as task_result pushes), and one blocking
+        # round trip per submission serialized bulk fan-outs; server-side
+        # failures still surface through the future's callback
+        self.gcs.call_async("submit_task", meta).add_done_callback(
+            _log_rpc_failure
+        )
         return refs
 
     def _track_submission(self, task_id: str, meta: dict,

@@ -84,6 +84,6 @@ def run_rounds_to_quiescence(
             gcs, running[: max(int(len(running) * drain_fraction), 1)]
         )
         with gcs._lock:
-            if not gcs.pending and not gcs.running:
+            if gcs.pending_task_count() == 0 and not gcs.running:
                 break
     return placements

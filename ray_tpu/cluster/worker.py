@@ -255,7 +255,12 @@ def main():  # pragma: no cover - runs as a subprocess
     host = os.environ["RAY_TPU_DAEMON_HOST"]
     port = int(os.environ["RAY_TPU_DAEMON_PORT"])
     worker_id = os.environ["RAY_TPU_WORKER_ID"]
-    client = RpcClient(host, port, timeout=120.0)
+    try:
+        client = RpcClient(host, port, timeout=120.0)
+    except OSError:
+        # daemon already gone (cluster tearing down while we spawned):
+        # exit quietly instead of spraying a traceback
+        return
     _daemon_client = client
     _attach_shm()
     tasks: "queue.Queue[dict]" = queue.Queue()

@@ -45,19 +45,29 @@ class Step:
 
     @property
     def step_id(self) -> str:
+        # Memoized (diamond DAGs would otherwise recompute ancestor hashes
+        # exponentially) and value-based: plain args hash by their PICKLED
+        # bytes, never repr() — a repr with a memory address would change
+        # across processes and break resume's result matching, and truncated
+        # array reprs could collide two different steps onto one result.
+        cached = self.__dict__.get("_sid")
+        if cached is not None:
+            return cached
+
+        def _aid(v):
+            if isinstance(v, Step):
+                return ("s", v.step_id)
+            return ("v", hashlib.sha1(serialization.dumps(v)).hexdigest())
+
         payload = serialization.dumps((
             getattr(self.func, "__module__", ""),
             getattr(self.func, "__qualname__", repr(self.func)),
-            tuple(
-                a.step_id if isinstance(a, Step) else ("v", repr(a))
-                for a in self.args
-            ),
-            tuple(sorted(
-                (k, v.step_id if isinstance(v, Step) else ("v", repr(v)))
-                for k, v in self.kwargs.items()
-            )),
+            tuple(_aid(a) for a in self.args),
+            tuple(sorted((k, _aid(v)) for k, v in self.kwargs.items())),
         ))
-        return hashlib.sha1(payload).hexdigest()[:20]
+        sid = hashlib.sha1(payload).hexdigest()[:20]
+        self.__dict__["_sid"] = sid
+        return sid
 
     def parents(self) -> List["Step"]:
         out = [a for a in self.args if isinstance(a, Step)]
