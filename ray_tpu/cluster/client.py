@@ -27,7 +27,7 @@ from ray_tpu.core.exceptions import (
 from ray_tpu.core.memory_store import MemoryStore
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.task_spec import TaskSpec, new_id
-from ray_tpu.cluster.rpc import ConnectionLost, RpcClient
+from ray_tpu.cluster.rpc import ConnectionLost, RpcClient, log_rpc_failure
 
 
 class _ActorQueue:
@@ -82,19 +82,6 @@ class _ActorQueue:
                     self._cv.wait(timeout=not_before - now)
                 else:
                     self._cv.wait()
-
-
-def _log_rpc_failure(fut):
-    """Done-callback for fire-and-forget RPCs: surfaces server-side errors
-    that would otherwise sit unread on the discarded future."""
-    try:
-        exc = fut.exception()
-    except Exception:  # noqa: BLE001 - cancelled
-        return
-    if exc is not None:
-        import sys
-
-        print(f"[ray_tpu] async rpc failed: {exc!r}", file=sys.stderr)
 
 
 def _parse_address(address) -> Tuple[str, int]:
@@ -418,12 +405,26 @@ class ClusterClient:
         with self._lock:
             self._task_meta[spec.task_id] = meta
         self._track_submission(spec.task_id, meta, refs)
-        # async submit: the ack carries nothing the client uses (deps-lost
-        # outcomes also arrive as task_result pushes), and one blocking
-        # round trip per submission serialized bulk fan-outs; server-side
-        # failures still surface through the future's callback
+        # async submit: the ack carries nothing the client uses on success
+        # (deps-lost outcomes also arrive as task_result pushes), and one
+        # blocking round trip per submission serialized bulk fan-outs. A
+        # SERVER-side failure means the task was never registered and no
+        # task_result will ever arrive — fail the refs so get() raises
+        # instead of hanging forever.
+        def _on_submit_done(fut, task_id=spec.task_id, refs=tuple(refs)):
+            try:
+                exc = fut.exception()
+            except Exception:  # noqa: BLE001 - cancelled
+                return
+            if exc is None:
+                return
+            err = TaskError(f"task submission failed: {exc}")
+            for r in refs:
+                self.store.put(r, err, is_exception=True)
+            self._release_task_deps(task_id)
+
         self.gcs.call_async("submit_task", meta).add_done_callback(
-            _log_rpc_failure
+            _on_submit_done
         )
         return refs
 

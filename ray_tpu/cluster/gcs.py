@@ -1153,6 +1153,13 @@ class GcsServer:
             failed: List[tuple] = []
             for _ in range(len(self._special_queue)):
                 t = self._special_queue.popleft()
+                if t.get("actor_creation"):
+                    # killed while queued (same check the bucket pop does)
+                    a = self.actors.get(t.get("actor_id"))
+                    if a is not None and a["state"] == "DEAD":
+                        self._queued_ids.discard(t["task_id"])
+                        self._track_exit(t)
+                        continue
                 kind, payload = self._schedule_special(t)
                 if kind == "dispatch":
                     self._queued_ids.discard(t["task_id"])
@@ -1459,16 +1466,32 @@ class GcsServer:
                     )
                 ]
 
+            def _requeue_or_lose(t) -> Optional[bool]:
+                """None = keep queued; True = handed back (deps lost);
+                False = re-parked at the dependency gate (dep missing but a
+                retrying producer will recreate it — dispatching now would
+                tie a prefetch thread up waiting for an object that doesn't
+                exist yet)."""
+                if not t.get("deps"):
+                    return None
+                lost = _dead_deps_of(t)
+                if lost:
+                    self._queued_ids.discard(t["task_id"])
+                    self._track_exit(t)
+                    deps_lost.append((t, lost))
+                    return True
+                missing = self._missing_deps(t)
+                if missing:
+                    self._queued_ids.discard(t["task_id"])
+                    self._enqueue_waiting(t, missing)
+                    return False
+                return None
+
             for key in list(self._class_buckets):
                 b = self._class_buckets[key]
                 kept: deque = deque()
                 for t in b["q"]:
-                    lost = _dead_deps_of(t) if t.get("deps") else []
-                    if lost:
-                        self._queued_ids.discard(t["task_id"])
-                        self._track_exit(t)
-                        deps_lost.append((t, lost))
-                    else:
+                    if _requeue_or_lose(t) is None:
                         kept.append(t)
                 if kept:
                     b["q"] = kept
@@ -1476,12 +1499,7 @@ class GcsServer:
                     del self._class_buckets[key]
             for _ in range(len(self._special_queue)):
                 t = self._special_queue.popleft()
-                lost = _dead_deps_of(t) if t.get("deps") else []
-                if lost:
-                    self._queued_ids.discard(t["task_id"])
-                    self._track_exit(t)
-                    deps_lost.append((t, lost))
-                else:
+                if _requeue_or_lose(t) is None:
                     self._special_queue.append(t)
             for tid, w in list(self.waiting_tasks.items()):
                 # check EVERY dep: a previously-satisfied one may have just

@@ -26,7 +26,7 @@ from typing import Any, Dict, List, Optional
 
 from ray_tpu.core.config import Config
 from ray_tpu.core.task_spec import new_id
-from ray_tpu.cluster.rpc import RpcClient, RpcServer
+from ray_tpu.cluster.rpc import RpcClient, RpcServer, log_rpc_failure
 
 
 class ObjectStore:
@@ -163,17 +163,6 @@ class ObjectStore:
             }
 
 
-def _log_rpc_failure(fut):
-    """Done-callback for fire-and-forget RPCs: a server-side exception set
-    on an unread future would otherwise disappear without a trace."""
-    try:
-        exc = fut.exception()
-    except Exception:  # noqa: BLE001 - cancelled
-        return
-    if exc is not None:
-        print(f"[ray_tpu] async rpc failed: {exc!r}", file=sys.stderr)
-
-
 class _Worker:
     def __init__(self, worker_id: str, proc: subprocess.Popen):
         self.worker_id = worker_id
@@ -277,10 +266,7 @@ class NodeDaemon:
         # _spawn_worker -> self.gcs.host) before __init__'s assignment runs.
         self.gcs = gcs
         gcs.subscribe("exec_task", self._on_exec_task)
-        gcs.subscribe(
-            "exec_tasks",
-            lambda ts: [self._on_exec_task(t) for t in ts],
-        )
+        gcs.subscribe("exec_tasks", self._on_exec_tasks)
         gcs.subscribe("kill_actor", self._on_kill_actor)
         gcs.subscribe("free_objects", lambda p: self.store.delete(p["object_ids"]))
         gcs.subscribe(
@@ -651,6 +637,23 @@ class NodeDaemon:
 
     # --------------------------------------------------------- task dispatch
 
+    def _on_exec_tasks(self, ts: List[dict]):
+        """Batched dispatch frame: per-task isolation — one bad task (e.g.
+        a worker-spawn OSError) must not strand the rest of the batch in
+        the GCS running table."""
+        for t in ts:
+            try:
+                self._on_exec_task(t)
+            except Exception:  # noqa: BLE001
+                traceback.print_exc()
+                try:
+                    self._report_done(
+                        t, status="WORKER_DIED",
+                        error="daemon failed to accept dispatch",
+                    )
+                except Exception:  # noqa: BLE001
+                    traceback.print_exc()
+
     def _on_exec_task(self, t: dict):
         # nested deps (refs inside arg values) are pinned/gated but NOT
         # prefetched — the task may never get() them, and a worker that does
@@ -824,7 +827,7 @@ class NodeDaemon:
             # cluster throughput at ~140 tasks/s). Remote failures surface
             # via the future's callback, not silently vanish.
             self.gcs.call_async("task_done", payload).add_done_callback(
-                _log_rpc_failure
+                log_rpc_failure
             )
         except Exception:
             traceback.print_exc()

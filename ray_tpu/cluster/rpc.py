@@ -29,6 +29,19 @@ class RpcError(Exception):
     pass
 
 
+def log_rpc_failure(fut):
+    """Done-callback for fire-and-forget call_async uses: a server-side
+    exception set on an unread future would otherwise vanish silently."""
+    try:
+        exc = fut.exception()
+    except Exception:  # noqa: BLE001 - cancelled
+        return
+    if exc is not None:
+        import sys
+
+        print(f"[ray_tpu] async rpc failed: {exc!r}", file=sys.stderr)
+
+
 class ConnectionLost(RpcError):
     pass
 

@@ -1,0 +1,24 @@
+"""RL stack: EnvRunner actors sample, a jitted JAX learner trains, the
+Algorithm loop coordinates — the capability-level equivalent of the
+reference's RLlib (rllib/algorithms/algorithm.py, env/env_runner_group.py,
+core/learner/). The algorithm zoo is deliberately thin (PG + PPO-clip on
+built-in envs); the ORCHESTRATION — remote sampling fleet, weight
+broadcast, learner group, checkpoints — is the component the survey
+inventories.
+"""
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.env import CartPole, make_env, register_env
+from ray_tpu.rllib.env_runner import EnvRunner
+from ray_tpu.rllib.learner import Learner, LearnerGroup
+
+__all__ = [
+    "Algorithm",
+    "AlgorithmConfig",
+    "CartPole",
+    "EnvRunner",
+    "Learner",
+    "LearnerGroup",
+    "make_env",
+    "register_env",
+]

@@ -1,0 +1,160 @@
+"""Algorithm: the RLlib-style outer loop over EnvRunner actors + a
+LearnerGroup.
+
+Reference: rllib/algorithms/algorithm.py (Algorithm.train iterating
+sample -> learn), algorithm_config.py (builder-style config), and
+env_runner_group.py (the remote sampling fleet). Orchestration rides this
+framework's own actor layer; the learning math is jitted JAX (learner.py).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+@dataclass
+class AlgorithmConfig:
+    env: Any = "CartPole-v1"
+    algo: str = "pg"  # "pg" (REINFORCE+baseline) | "ppo" (clip)
+    num_env_runners: int = 2
+    rollout_fragment_length: int = 512
+    train_batch_size: int = 2048
+    lr: float = 3e-3
+    gamma: float = 0.99
+    hidden: int = 64
+    seed: int = 0
+    num_updates_per_iter: int = 1
+
+    # builder-style helpers (reference: AlgorithmConfig chaining)
+    def environment(self, env) -> "AlgorithmConfig":
+        self.env = env
+        return self
+
+    def env_runners(self, num_env_runners: int,
+                    rollout_fragment_length: Optional[int] = None
+                    ) -> "AlgorithmConfig":
+        self.num_env_runners = num_env_runners
+        if rollout_fragment_length:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, lr: Optional[float] = None,
+                 train_batch_size: Optional[int] = None,
+                 gamma: Optional[float] = None) -> "AlgorithmConfig":
+        if lr is not None:
+            self.lr = lr
+        if train_batch_size is not None:
+            self.train_batch_size = train_batch_size
+        if gamma is not None:
+            self.gamma = gamma
+        return self
+
+    def build(self) -> "Algorithm":
+        return Algorithm(self)
+
+
+class Algorithm:
+    def __init__(self, config: AlgorithmConfig):
+        import ray_tpu
+        from ray_tpu.rllib.env import make_env
+        from ray_tpu.rllib.env_runner import EnvRunner
+        from ray_tpu.rllib.learner import LearnerGroup
+
+        self.config = config
+        probe = make_env(config.env, seed=config.seed)
+        self.learner_group = LearnerGroup(
+            obs_size=probe.observation_size,
+            num_actions=probe.num_actions,
+            lr=config.lr,
+            algo=config.algo,
+            hidden=config.hidden,
+            train_batch_size=config.train_batch_size,
+            seed=config.seed,
+        )
+        runner_cls = ray_tpu.remote(EnvRunner)
+        self.env_runners = [
+            runner_cls.remote(
+                config.env,
+                seed=config.seed * 10_000 + i,
+                rollout_fragment_length=config.rollout_fragment_length,
+                gamma=config.gamma,
+            )
+            for i in range(config.num_env_runners)
+        ]
+        self.iteration = 0
+
+    def train(self) -> Dict[str, Any]:
+        """One iteration: broadcast weights -> parallel sample -> learn."""
+        import ray_tpu
+
+        t0 = time.time()
+        weights = self.learner_group.get_weights()
+        batches = ray_tpu.get(
+            [r.sample.remote(weights) for r in self.env_runners],
+            timeout=600,
+        )
+        batch = {
+            k: np.concatenate([b[k] for b in batches])
+            for k in ("obs", "actions", "returns", "logp_old")
+        }
+        stats: Dict[str, float] = {}
+        for _ in range(self.config.num_updates_per_iter):
+            stats = self.learner_group.update(batch)
+        self.iteration += 1
+        ep_means = [
+            float(b["episode_reward_mean"]) for b in batches
+            if not np.isnan(b["episode_reward_mean"])
+        ]
+        return {
+            "training_iteration": self.iteration,
+            "episode_reward_mean": (
+                float(np.mean(ep_means)) if ep_means else float("nan")
+            ),
+            "episodes_this_iter": int(
+                sum(int(b["episodes_done"]) for b in batches)
+            ),
+            "num_env_steps_sampled": len(batch["obs"]),
+            "time_this_iter_s": round(time.time() - t0, 3),
+            **stats,
+        }
+
+    # ----------------------------------------------------- checkpointing
+
+    def save(self, checkpoint_dir: str) -> str:
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        path = os.path.join(checkpoint_dir, "algorithm_state.pkl")
+        with open(path, "wb") as f:
+            pickle.dump({
+                "weights": self.learner_group.get_weights(),
+                "opt_state": self.learner_group.learner.opt_state,
+                "iteration": self.iteration,
+                "config": self.config,
+            }, f)
+        return checkpoint_dir
+
+    def restore(self, checkpoint_dir: str) -> None:
+        with open(
+            os.path.join(checkpoint_dir, "algorithm_state.pkl"), "rb"
+        ) as f:
+            state = pickle.load(f)
+        self.learner_group.set_weights(state["weights"])
+        self.learner_group.learner.opt_state = state["opt_state"]
+        self.iteration = state["iteration"]
+
+    def get_weights(self):
+        return self.learner_group.get_weights()
+
+    def stop(self) -> None:
+        import ray_tpu
+
+        for r in self.env_runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:  # noqa: BLE001
+                pass

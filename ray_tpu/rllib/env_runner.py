@@ -1,0 +1,87 @@
+"""EnvRunner: an actor that owns env instances and samples with the
+current policy.
+
+Reference: rllib/env/single_agent_env_runner.py + env_runner_group.py (the
+old WorkerSet) — sampling runs on remote actors; the algorithm broadcasts
+weights and gathers batches.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class EnvRunner:
+    """Plain class; the Algorithm wraps it with @remote so instances become
+    actors (sampling then overlaps across runners)."""
+
+    def __init__(self, env_spec: Any, seed: int = 0,
+                 rollout_fragment_length: int = 512, gamma: float = 0.99):
+        from ray_tpu.rllib.env import make_env
+
+        self.env = make_env(env_spec, seed=seed)
+        self.rollout_fragment_length = rollout_fragment_length
+        self.gamma = gamma
+        self._seed = seed
+        self._episodes = 0
+        self._obs, _ = self.env.reset(seed=seed)
+        self._ep_reward = 0.0
+        self._ep_rewards_window: List[float] = []
+
+    def sample(self, params) -> Dict[str, np.ndarray]:
+        """Collect one fragment with the given policy weights. Returns flat
+        arrays plus reward-to-go returns computed per episode segment."""
+        import jax
+
+        from ray_tpu.rllib import policy as pol
+
+        key = jax.random.PRNGKey(
+            (self._seed * 1_000_003 + self._episodes * 7919 + len(
+                self._ep_rewards_window)) % (2**31)
+        )
+        obs_buf, act_buf, rew_buf, logp_buf = [], [], [], []
+        done_idx = []  # fragment indices where an episode ended
+        for i in range(self.rollout_fragment_length):
+            key, sub = jax.random.split(key)
+            a, logp = pol.sample_actions(
+                params, self._obs[None, :], sub
+            )
+            a = int(np.asarray(a)[0])
+            obs_buf.append(self._obs)
+            next_obs, r, term, trunc, _ = self.env.step(a)
+            act_buf.append(a)
+            rew_buf.append(r)
+            logp_buf.append(float(np.asarray(logp)[0]))
+            self._ep_reward += r
+            self._obs = next_obs
+            if term or trunc:
+                done_idx.append(i)
+                self._ep_rewards_window.append(self._ep_reward)
+                self._ep_rewards_window = self._ep_rewards_window[-20:]
+                self._ep_reward = 0.0
+                self._episodes += 1
+                self._obs, _ = self.env.reset()
+
+        rewards = np.asarray(rew_buf, np.float32)
+        returns = np.zeros_like(rewards)
+        running = 0.0
+        ends = set(done_idx)
+        for i in range(len(rewards) - 1, -1, -1):
+            if i in ends:
+                running = 0.0
+            running = rewards[i] + self.gamma * running
+            returns[i] = running
+        return {
+            "obs": np.asarray(obs_buf, np.float32),
+            "actions": np.asarray(act_buf, np.int32),
+            "rewards": rewards,
+            "returns": returns,
+            "logp_old": np.asarray(logp_buf, np.float32),
+            "episodes_done": np.int64(len(done_idx)),
+            "episode_reward_mean": np.float32(
+                np.mean(self._ep_rewards_window)
+                if self._ep_rewards_window else np.nan
+            ),
+        }

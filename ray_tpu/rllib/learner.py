@@ -1,0 +1,93 @@
+"""Learner + LearnerGroup: the train-math side of the RL stack.
+
+Reference: rllib/core/learner/learner.py (per-learner update step) and
+learner_group.py (the coordination wrapper Train/RLlib share). TPU-first:
+one Learner = one jitted update program over static padded batch shapes;
+scaling across devices is jax sharding inside the program, not N learner
+processes shipping gradients.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+class Learner:
+    def __init__(self, obs_size: int, num_actions: int, lr: float = 3e-3,
+                 algo: str = "pg", hidden: int = 64,
+                 train_batch_size: int = 2048, seed: int = 0):
+        import jax.numpy as jnp  # noqa: F401 - ensures jax configured
+
+        from ray_tpu.rllib import policy as pol
+
+        self.algo = algo
+        self.train_batch_size = train_batch_size
+        self.params = pol.init_params(
+            np.random.default_rng(seed), obs_size, num_actions, hidden
+        )
+        self.optimizer = pol.make_optimizer(lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self._updates = 0
+
+    def get_weights(self):
+        return self.params
+
+    def set_weights(self, params):
+        self.params = params
+
+    def _pad(self, batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        """Pad to the static train_batch_size so the jitted update compiles
+        once (masked math ignores the padding)."""
+        import jax.numpy as jnp
+
+        n = len(batch["obs"])
+        size = self.train_batch_size
+        if n > size:
+            batch = {k: v[:size] for k, v in batch.items()}
+            n = size
+        out = {}
+        for k in ("obs", "actions", "returns", "logp_old"):
+            v = batch[k]
+            pad_shape = (size - n,) + v.shape[1:]
+            out[k] = jnp.asarray(
+                np.concatenate([v, np.zeros(pad_shape, v.dtype)])
+            )
+        mask = np.zeros(size, np.float32)
+        mask[:n] = 1.0
+        out["mask"] = jnp.asarray(mask)
+        return out
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        from ray_tpu.rllib import policy as pol
+
+        padded = self._pad(batch)
+        fn = pol.ppo_update if self.algo == "ppo" else pol.pg_update
+        self.params, self.opt_state, stats = fn(
+            self.params, self.opt_state, padded, self.optimizer
+        )
+        self._updates += 1
+        return {k: float(v) for k, v in stats.items()} | {
+            "num_updates": self._updates,
+        }
+
+
+class LearnerGroup:
+    """Owns the learner(s). v1 runs ONE learner in-process — on TPU the
+    data-parallel scaling lives INSIDE the jitted update (sharded batch
+    over the mesh), so multiple learner processes only buy DCN scale,
+    which this image can't exercise. The group API matches the reference
+    so that seam is ready."""
+
+    def __init__(self, **learner_kwargs):
+        self.learner = Learner(**learner_kwargs)
+
+    def update(self, batch) -> Dict[str, float]:
+        return self.learner.update(batch)
+
+    def get_weights(self):
+        return self.learner.get_weights()
+
+    def set_weights(self, w):
+        self.learner.set_weights(w)

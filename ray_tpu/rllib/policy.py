@@ -1,0 +1,136 @@
+"""JAX policy: MLP categorical actor (+ value head) with jitted update.
+
+Reference structure being matched: rllib/core/learner/learner.py owns the
+train math; rllib/policy/ the action computation. TPU-first: the policy
+forward and the whole update step are single jitted programs over fixed
+batch shapes — no per-sample Python, gradients via jax.grad, Adam via optax.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+def init_params(rng: np.random.Generator, obs_size: int, num_actions: int,
+                hidden: int = 64) -> Dict[str, jnp.ndarray]:
+    def dense(fan_in, fan_out):
+        w = rng.normal(0, np.sqrt(2.0 / fan_in), (fan_in, fan_out))
+        return jnp.asarray(w, jnp.float32), jnp.zeros(fan_out, jnp.float32)
+
+    w1, b1 = dense(obs_size, hidden)
+    w2, b2 = dense(hidden, hidden)
+    wp, bp = dense(hidden, num_actions)
+    wv, bv = dense(hidden, 1)
+    return {"w1": w1, "b1": b1, "w2": w2, "b2": b2,
+            "wp": wp, "bp": bp, "wv": wv, "bv": bv}
+
+
+def _trunk(params, obs):
+    h = jnp.tanh(obs @ params["w1"] + params["b1"])
+    return jnp.tanh(h @ params["w2"] + params["b2"])
+
+
+@jax.jit
+def action_logits(params, obs):
+    return _trunk(params, obs) @ params["wp"] + params["bp"]
+
+
+@jax.jit
+def value(params, obs):
+    return (_trunk(params, obs) @ params["wv"] + params["bv"]).squeeze(-1)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def sample_actions(params, obs, key):
+    """Batched categorical sampling; returns (actions, logprobs)."""
+    logits = action_logits(params, obs)
+    actions = jax.random.categorical(key, logits)
+    logp = jax.nn.log_softmax(logits)
+    return actions, jnp.take_along_axis(
+        logp, actions[:, None], axis=1
+    ).squeeze(-1)
+
+
+def make_optimizer(lr: float):
+    return optax.adam(lr)
+
+
+@functools.partial(jax.jit, static_argnames=("optimizer",))
+def pg_update(params, opt_state, batch, optimizer):
+    """REINFORCE with a learned value baseline, one jitted step.
+
+    batch: obs [B, O], actions [B], returns [B] (reward-to-go), mask [B]
+    (1 for real transitions, 0 for padding — batches are padded to a
+    static size so jit compiles once)."""
+    def loss_fn(p):
+        logits = _trunk(p, batch["obs"]) @ p["wp"] + p["bp"]
+        logp = jax.nn.log_softmax(logits)
+        act_logp = jnp.take_along_axis(
+            logp, batch["actions"][:, None].astype(jnp.int32), axis=1
+        ).squeeze(-1)
+        v = (_trunk(p, batch["obs"]) @ p["wv"] + p["bv"]).squeeze(-1)
+        adv = batch["returns"] - jax.lax.stop_gradient(v)
+        m = batch["mask"]
+        n = jnp.maximum(m.sum(), 1.0)
+        adv_n = (adv - (adv * m).sum() / n) / (
+            jnp.sqrt(((adv - (adv * m).sum() / n) ** 2 * m).sum() / n) + 1e-6
+        )
+        pg_loss = -(act_logp * jax.lax.stop_gradient(adv_n) * m).sum() / n
+        v_loss = (jnp.square(batch["returns"] - v) * m).sum() / n
+        entropy = -(jnp.exp(logp) * logp).sum(-1)
+        ent_bonus = (entropy * m).sum() / n
+        return pg_loss + 0.5 * v_loss - 0.01 * ent_bonus, (pg_loss, v_loss)
+
+    (loss, (pg_l, v_l)), grads = jax.value_and_grad(
+        loss_fn, has_aux=True
+    )(params)
+    updates, opt_state = optimizer.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    return params, opt_state, {
+        "total_loss": loss, "pg_loss": pg_l, "vf_loss": v_l,
+    }
+
+
+@functools.partial(jax.jit, static_argnames=("optimizer",))
+def ppo_update(params, opt_state, batch, optimizer, clip: float = 0.2):
+    """PPO-clip surrogate, one jitted epoch over the batch.
+
+    batch additionally carries old logprobs (behavior policy)."""
+    def loss_fn(p):
+        logits = _trunk(p, batch["obs"]) @ p["wp"] + p["bp"]
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            logp_all, batch["actions"][:, None].astype(jnp.int32), axis=1
+        ).squeeze(-1)
+        v = (_trunk(p, batch["obs"]) @ p["wv"] + p["bv"]).squeeze(-1)
+        m = batch["mask"]
+        n = jnp.maximum(m.sum(), 1.0)
+        adv = batch["returns"] - jax.lax.stop_gradient(v)
+        adv = (adv - (adv * m).sum() / n) / (
+            jnp.sqrt(((adv - (adv * m).sum() / n) ** 2 * m).sum() / n) + 1e-6
+        )
+        ratio = jnp.exp(logp - batch["logp_old"])
+        surr = jnp.minimum(
+            ratio * adv,
+            jnp.clip(ratio, 1 - clip, 1 + clip) * adv,
+        )
+        pg_loss = -(surr * m).sum() / n
+        v_loss = (jnp.square(batch["returns"] - v) * m).sum() / n
+        entropy = -(jnp.exp(logp_all) * logp_all).sum(-1)
+        ent_bonus = (entropy * m).sum() / n
+        return pg_loss + 0.5 * v_loss - 0.01 * ent_bonus, (pg_loss, v_loss)
+
+    (loss, (pg_l, v_l)), grads = jax.value_and_grad(
+        loss_fn, has_aux=True
+    )(params)
+    updates, opt_state = optimizer.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    return params, opt_state, {
+        "total_loss": loss, "pg_loss": pg_l, "vf_loss": v_l,
+    }

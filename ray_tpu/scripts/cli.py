@@ -194,6 +194,25 @@ def cmd_microbenchmark(args):
     perf_main(address=getattr(args, "address", None), quick=args.quick)
 
 
+def cmd_up(args):
+    from ray_tpu.autoscaler.launcher import cluster_up
+    from ray_tpu.util.usage import record_event
+
+    state = cluster_up(args.config)
+    record_event("cluster_up", cluster=state["cluster_name"],
+                 nodes=len(state["pids"]) - 1)
+    print(f"cluster {state['cluster_name']!r} up at {state['address']} "
+          f"({len(state['pids'])} processes)")
+    print(f"connect with: ray_tpu.init(address={state['address']!r})")
+
+
+def cmd_down(args):
+    from ray_tpu.autoscaler.launcher import cluster_down
+
+    killed = cluster_down(args.cluster)
+    print(f"terminated {len(killed)} processes")
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="ray_tpu", description=__doc__)
     sub = p.add_subparsers(dest="command", required=True)
@@ -232,6 +251,14 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--address")
     sp.add_argument("--quick", action="store_true")
     sp.set_defaults(fn=cmd_microbenchmark)
+
+    sp = sub.add_parser("up", help="launch a cluster from a YAML config")
+    sp.add_argument("config", help="cluster YAML path")
+    sp.set_defaults(fn=cmd_up)
+
+    sp = sub.add_parser("down", help="tear down a launched cluster")
+    sp.add_argument("cluster", help="cluster name or YAML path")
+    sp.set_defaults(fn=cmd_down)
     return p
 
 

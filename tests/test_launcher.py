@@ -1,0 +1,116 @@
+"""Cluster launcher (`ray_tpu up/down`) + usage telemetry.
+
+Reference: python/ray/autoscaler/_private/commands.py (up/down from a
+cluster YAML) and python/ray/_private/usage/usage_lib.py (opt-out stats).
+"""
+
+import json
+import os
+import time
+
+import pytest
+import yaml
+
+import ray_tpu
+from ray_tpu.autoscaler.launcher import (
+    cluster_down,
+    cluster_up,
+    list_clusters,
+    load_cluster_config,
+)
+
+
+def _write_cfg(tmp_path, name):
+    cfg = {
+        "cluster_name": name,
+        "provider": {"type": "local"},
+        "head_node": {"num_cpus": 2},
+        "worker_nodes": {"count": 1, "num_cpus": 2},
+    }
+    path = tmp_path / "cluster.yaml"
+    path.write_text(yaml.safe_dump(cfg))
+    return str(path)
+
+
+def _alive(pid):
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+
+
+def test_up_run_task_down(tmp_path):
+    cfg_path = _write_cfg(tmp_path, "t-launch")
+    state = cluster_up(cfg_path)
+    try:
+        assert state["address"].startswith("127.0.0.1:")
+        assert len(state["pids"]) == 3  # head + head daemon + 1 worker
+        assert all(_alive(p) for p in state["pids"])
+        assert any(
+            c["cluster_name"] == "t-launch" for c in list_clusters()
+        )
+
+        ray_tpu.init(address=state["address"])
+
+        @ray_tpu.remote
+        def ping():
+            return "pong"
+
+        assert ray_tpu.get(ping.remote(), timeout=90) == "pong"
+        res = ray_tpu.cluster_resources()
+        assert res.get("CPU") == 4.0  # 2 (head) + 2 (worker)
+        ray_tpu.shutdown()
+    finally:
+        try:
+            killed = cluster_down(cfg_path)
+        except RuntimeError:
+            killed = []
+    deadline = time.time() + 10
+    while time.time() < deadline and any(_alive(p) for p in state["pids"]):
+        time.sleep(0.2)
+    assert not any(_alive(p) for p in state["pids"])
+    assert killed
+
+
+def test_double_up_refused_and_down_unknown(tmp_path):
+    cfg_path = _write_cfg(tmp_path, "t-dup")
+    state = cluster_up(cfg_path)
+    try:
+        with pytest.raises(RuntimeError, match="already has a state file"):
+            cluster_up(cfg_path)
+    finally:
+        cluster_down(cfg_path)
+    with pytest.raises(RuntimeError, match="no state file"):
+        cluster_down("t-dup")
+    del state
+
+
+def test_nonlocal_provider_rejected(tmp_path):
+    path = tmp_path / "aws.yaml"
+    path.write_text(yaml.safe_dump({
+        "cluster_name": "c", "provider": {"type": "aws"},
+    }))
+    with pytest.raises(ValueError, match="not available in this image"):
+        load_cluster_config(str(path))
+
+
+def test_usage_telemetry_opt_out(tmp_path, monkeypatch):
+    from ray_tpu.core import config as config_mod
+    from ray_tpu.util.usage import record_event, usage_stats_enabled
+
+    monkeypatch.setitem(
+        config_mod.GLOBAL_CONFIG._values, "session_dir_root", str(tmp_path)
+    )
+    assert usage_stats_enabled()
+    record_event("unit_test", detail=1)
+    usage_file = tmp_path / "usage" / "usage.jsonl"
+    assert usage_file.exists()
+    rec = json.loads(usage_file.read_text().splitlines()[-1])
+    assert rec["event"] == "unit_test" and rec["detail"] == 1
+
+    monkeypatch.setenv("RAY_TPU_usage_stats_enabled", "false")
+    assert not usage_stats_enabled()
+    n_before = len(usage_file.read_text().splitlines())
+    record_event("should_not_appear")
+    assert len(usage_file.read_text().splitlines()) == n_before

@@ -1,0 +1,157 @@
+"""RL stack tests (reference analog: rllib/tests/ smoke training on
+CartPole via tuned_examples)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import Algorithm, AlgorithmConfig, CartPole, register_env
+from ray_tpu.rllib.env import make_env
+
+
+@pytest.fixture
+def local_rt():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_cartpole_physics():
+    env = CartPole(seed=0)
+    obs, _ = env.reset(seed=0)
+    assert obs.shape == (4,)
+    total = 0.0
+    for _ in range(10):
+        obs, r, term, trunc, _ = env.step(1)
+        total += r
+        if term or trunc:
+            break
+    assert total >= 1.0
+    # constant force topples the pole eventually
+    env.reset(seed=0)
+    done = False
+    for _ in range(500):
+        _, _, term, trunc, _ = env.step(1)
+        if term:
+            done = True
+            break
+    assert done
+
+
+def test_register_custom_env():
+    class Trivial:
+        observation_size = 2
+        num_actions = 2
+
+        def __init__(self):
+            self.t = 0
+
+        def reset(self, seed=None):
+            self.t = 0
+            return np.zeros(2, np.float32), {}
+
+        def step(self, a):
+            self.t += 1
+            return np.zeros(2, np.float32), 1.0, False, self.t >= 5, {}
+
+    register_env("Trivial-v0", Trivial)
+    env = make_env("Trivial-v0")
+    env.reset()
+    steps = 0
+    while True:
+        _, _, term, trunc, _ = env.step(0)
+        steps += 1
+        if term or trunc:
+            break
+    assert steps == 5
+
+
+def test_algorithm_iterates_and_reports(local_rt):
+    algo = (
+        AlgorithmConfig()
+        .environment("CartPole-v1")
+        .env_runners(2, rollout_fragment_length=128)
+        .training(train_batch_size=256)
+        .build()
+    )
+    try:
+        r = algo.train()
+        assert r["training_iteration"] == 1
+        assert r["num_env_steps_sampled"] == 256  # 2 runners x 128
+        assert "total_loss" in r
+        r2 = algo.train()
+        assert r2["training_iteration"] == 2
+    finally:
+        algo.stop()
+
+
+def test_pg_learns_cartpole(local_rt):
+    """Learning smoke: mean episode reward must clearly improve over
+    training (reference: tuned_examples CartPole runs)."""
+    algo = (
+        AlgorithmConfig()
+        .environment("CartPole-v1")
+        .env_runners(2, rollout_fragment_length=512)
+        .training(lr=5e-3, train_batch_size=1024)
+        .build()
+    )
+    try:
+        first = None
+        best = -np.inf
+        for i in range(25):
+            r = algo.train()
+            m = r["episode_reward_mean"]
+            if first is None and not np.isnan(m):
+                first = m
+            if not np.isnan(m):
+                best = max(best, m)
+            if best > 120:
+                break
+        assert first is not None
+        assert best > max(60.0, first * 1.5), (first, best)
+    finally:
+        algo.stop()
+
+
+def test_ppo_update_runs(local_rt):
+    algo = (
+        AlgorithmConfig(algo="ppo")
+        .environment("CartPole-v1")
+        .env_runners(1, rollout_fragment_length=128)
+        .training(train_batch_size=128)
+        .build()
+    )
+    try:
+        r = algo.train()
+        assert np.isfinite(r["total_loss"])
+    finally:
+        algo.stop()
+
+
+def test_checkpoint_roundtrip(local_rt, tmp_path):
+    cfg = (
+        AlgorithmConfig()
+        .environment("CartPole-v1")
+        .env_runners(1, rollout_fragment_length=64)
+        .training(train_batch_size=64)
+    )
+    algo = cfg.build()
+    try:
+        algo.train()
+        algo.save(str(tmp_path))
+        w1 = algo.get_weights()
+        it = algo.iteration
+    finally:
+        algo.stop()
+
+    algo2 = cfg.build()
+    try:
+        algo2.restore(str(tmp_path))
+        assert algo2.iteration == it
+        w2 = algo2.get_weights()
+        for k in w1:
+            np.testing.assert_array_equal(
+                np.asarray(w1[k]), np.asarray(w2[k])
+            )
+    finally:
+        algo2.stop()
