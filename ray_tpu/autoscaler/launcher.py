@@ -117,17 +117,30 @@ def cluster_up(config_path: str) -> Dict[str, Any]:
             os.path.abspath(__file__)
         ))) + os.pathsep + env.get("PYTHONPATH", "")
     )
-    head, port = _spawn_head(name, env)
-    pids = [head.pid]
-    head_res = _node_resources(cfg["head_node"])
-    pids.append(_spawn_daemon(port, head_res, f"{name}-head", env).pid)
-    workers = cfg["worker_nodes"]
-    count = int(workers.get("count", 0))
-    worker_res = _node_resources(workers) if count else {}
-    for i in range(count):
-        pids.append(
-            _spawn_daemon(port, worker_res, f"{name}-worker-{i}", env).pid
-        )
+    procs: List[subprocess.Popen] = []
+    try:
+        head, port = _spawn_head(name, env)
+        procs.append(head)
+        head_res = _node_resources(cfg["head_node"])
+        procs.append(_spawn_daemon(port, head_res, f"{name}-head", env))
+        workers = cfg["worker_nodes"]
+        count = int(workers.get("count", 0))
+        worker_res = _node_resources(workers) if count else {}
+        for i in range(count):
+            procs.append(
+                _spawn_daemon(port, worker_res, f"{name}-worker-{i}", env)
+            )
+    except BaseException:
+        # mid-sequence spawn failure: without a state file `down` could
+        # never find the survivors — tear down what already started
+        for p in procs:
+            try:
+                p.terminate()
+                p.wait(timeout=5)
+            except Exception:  # noqa: BLE001
+                pass
+        raise
+    pids = [p.pid for p in procs]
     state = {
         "cluster_name": name,
         "address": f"127.0.0.1:{port}",

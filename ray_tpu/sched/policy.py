@@ -62,6 +62,33 @@ class HybridPolicy(SchedulingPolicy):
         self._jax = None  # lazily built JaxScheduler (topology-dependent)
         self._topology_key = None
         self._rounds_since_full_sync = 0
+        # per-demand feasible-node counts (total capacity), cached per
+        # topology: feeds the constrained-first class ordering
+        self._feas_cache: dict = {}
+        self._feas_cache_key = None
+
+    def _constrained_order(self, state, demands: np.ndarray) -> np.ndarray:
+        """Most-constrained classes first (kernel_np.constrained_order
+        semantics), with the per-class feasible count memoized by demand
+        bytes — totals only change on topology events, and rebuilding the
+        [C, N, R] comparison every round at 10k nodes would cost ~10ms."""
+        key = (len(state.node_ids), state.total.tobytes(),
+               state.alive.tobytes())
+        if self._feas_cache_key != key:
+            self._feas_cache = {}
+            self._feas_cache_key = key
+        feas = np.empty(len(demands), np.int64)
+        for i, d in enumerate(demands):
+            k = d.tobytes()
+            v = self._feas_cache.get(k)
+            if v is None:
+                v = int((
+                    np.all(state.total + 1e-4 >= d[None, :], axis=1)
+                    & state.alive
+                ).sum())
+                self._feas_cache[k] = v
+            feas[i] = v
+        return np.argsort(feas, kind="stable")
 
     @property
     def name(self):
@@ -91,12 +118,19 @@ class HybridPolicy(SchedulingPolicy):
         return self._jax
 
     def schedule(self, state, demands, counts):
+        # most-constrained classes first (measured: closes the masked-
+        # feasibility makespan gap from ~5% to ~0 vs per-task greedy)
+        order = self._constrained_order(state, demands)
+        inv = np.empty_like(order)
+        inv[order] = np.arange(len(order))
+        demands_o = demands[order]
+        counts_o = np.asarray(counts)[order]
         if self.backend == "jax":
             sched = self._jax_sched(state)
             self._rounds_since_full_sync += 1
             assigned = sched.schedule(
-                demands, counts, self.spread_threshold, algo=self.algo
-            )
+                demands_o, counts_o, self.spread_threshold, algo=self.algo
+            )[inv]
             # keep the host view authoritative (device copy is a cache);
             # this assignment bypasses dirty tracking on purpose — the
             # device already holds the post-schedule view (kernel output)
@@ -105,16 +139,18 @@ class HybridPolicy(SchedulingPolicy):
             return assigned
         if self.algo == "rounds":
             assigned, new_avail = kernel_np.schedule_classes_rounds(
-                state.available, state.total, state.alive, demands, counts,
+                state.available, state.total, state.alive,
+                demands_o, counts_o,
                 spread_threshold=self.spread_threshold,
             )
         else:
             assigned, new_avail = kernel_np.schedule_classes(
-                state.available, state.total, state.alive, demands, counts,
+                state.available, state.total, state.alive,
+                demands_o, counts_o,
                 spread_threshold=self.spread_threshold,
             )
         state.replace_available(new_avail)
-        return assigned
+        return assigned[inv]
 
 
 class SpreadPolicy(SchedulingPolicy):

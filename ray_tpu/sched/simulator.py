@@ -83,9 +83,14 @@ def _batched_round(
     algo: str,
     jax_sched=None,
 ) -> Tuple[List[Tuple[int, int]], np.ndarray]:
-    """One batched kernel round over the whole queue. Returns (placements,
+    """One batched kernel round over the whole queue (most-constrained
+    classes first, like the production policy). Returns (placements,
     new_avail); mutates `queue`."""
-    counts = np.array(queue, dtype=np.int32)
+    order = kernel_np.constrained_order(total, alive, demands)
+    inv = np.empty_like(order)
+    inv[order] = np.arange(len(order))
+    demands = demands[order]
+    counts = np.array(queue, dtype=np.int32)[order]
     if jax_sched is not None:
         # the host view is authoritative (completions freed resources since
         # the last round); push it to the device before scheduling
@@ -105,6 +110,8 @@ def _batched_round(
             avail, total, alive, demands, counts,
             spread_threshold=spread_threshold,
         )
+    assigned = np.asarray(assigned)[inv]  # back to caller's class indexing
+    demands = demands[inv]
     placements: List[Tuple[int, int]] = []
     for c in range(demands.shape[0]):
         row = assigned[c]

@@ -95,7 +95,7 @@ def test_makespan_gap_small_homogeneous():
     gap = makespan_gap_pct(total, alive, demands, counts, durations)
     assert gap["unplaced_greedy"] == 0
     assert gap["unplaced_batched"] == 0
-    assert abs(gap["makespan_gap_pct"]) <= 3.0, gap
+    assert gap["makespan_gap_pct"] <= 3.0, gap
 
 
 @pytest.mark.parametrize("scheduler", ["classes", "rounds"])
@@ -110,7 +110,7 @@ def test_makespan_gap_small_heterogeneous(scheduler):
         total, alive, demands, counts, durations, scheduler=scheduler
     )
     assert gap["unplaced_batched"] == 0
-    assert abs(gap["makespan_gap_pct"]) <= 5.0, gap
+    assert gap["makespan_gap_pct"] <= 5.0, gap
 
 
 def test_masked_feasibility_gpu_custom():
@@ -124,7 +124,9 @@ def test_masked_feasibility_gpu_custom():
     )
     gap = makespan_gap_pct(total, alive, demands, counts, durations)
     assert gap["unplaced_batched"] == gap["unplaced_greedy"]
-    assert abs(gap["makespan_gap_pct"]) <= 8.0, gap
+    # constrained-first class ordering holds this within the north-star 3%
+    # (it typically BEATS greedy here — negative gap)
+    assert gap["makespan_gap_pct"] <= 3.0, gap
 
 
 def test_dead_nodes_excluded():
@@ -153,7 +155,7 @@ def test_makespan_gap_contended(scheduler):
     )
     assert gap["unplaced_batched"] == 0
     assert gap["greedy_rounds"] > 3  # really multi-wave
-    assert abs(gap["makespan_gap_pct"]) <= 5.0, gap
+    assert gap["makespan_gap_pct"] <= 5.0, gap
 
 
 def test_jax_backend_matches_numpy():
