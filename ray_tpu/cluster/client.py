@@ -422,9 +422,17 @@ class ClusterClient:
             except Exception:  # noqa: BLE001 - cancelled
                 return
             if exc is not None:
-                self._fail_task_refs(
-                    meta["task_id"], meta, f"submission failed: {exc}"
-                )
+                # off-thread: this callback fires on the gcs READER thread,
+                # where blocking RPCs (_publish_error -> daemon.call) are
+                # forbidden — they'd stall every push/result and, on
+                # connection loss with K pending submits, delay reconnect
+                # by K x the rpc timeout
+                threading.Thread(
+                    target=self._fail_task_refs,
+                    args=(meta["task_id"], meta,
+                          f"submission failed: {exc}"),
+                    daemon=True, name="submit-fail",
+                ).start()
 
         self.gcs.call_async("submit_task", meta).add_done_callback(_cb)
 

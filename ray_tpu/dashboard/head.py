@@ -1,0 +1,102 @@
+"""HTTP/JSON dashboard head over the state API.
+
+Reference: python/ray/dashboard/head.py (aiohttp app aggregating GCS
+state) and modules/state/state_head.py (the `/api/...` state routes).
+stdlib ThreadingHTTPServer here — the image has no aiohttp, and the
+endpoint surface is the component, not the web stack.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+
+class DashboardHead:
+    """Serves cluster state as JSON; one instance per driver/head.
+
+    Endpoints (all GET):
+      /api/summary              cluster counts
+      /api/nodes                node table
+      /api/actors               actor table
+      /api/tasks?limit=N        recent task events
+      /api/placement_groups     PG table
+      /api/cluster_resources    total resources
+      /api/available_resources  free resources
+      /                         endpoint index
+    """
+
+    def __init__(self, gcs_address: str, host: str = "127.0.0.1",
+                 port: int = 0):
+        from ray_tpu.cluster.client import ClusterClient
+
+        self._client = ClusterClient(gcs_address)
+        head = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet access log
+                pass
+
+            def do_GET(self):
+                try:
+                    body, status = head._route(self.path)
+                except Exception as e:  # noqa: BLE001
+                    body, status = {"error": repr(e)}, 500
+                data = json.dumps(body, default=str).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.host = host
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="dashboard-head",
+        )
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def _route(self, path: str):
+        route, _, query = path.partition("?")
+        params: Dict[str, str] = {}
+        for pair in query.split("&"):
+            if "=" in pair:
+                k, _, v = pair.partition("=")
+                params[k] = v
+        c = self._client
+        if route in ("/", "/api"):
+            return {
+                "endpoints": [
+                    "/api/summary", "/api/nodes", "/api/actors",
+                    "/api/tasks?limit=N", "/api/placement_groups",
+                    "/api/cluster_resources", "/api/available_resources",
+                ]
+            }, 200
+        if route == "/api/summary":
+            return c.summary(), 200
+        if route == "/api/nodes":
+            return c.nodes(), 200
+        if route == "/api/actors":
+            return c.list_actors(), 200
+        if route == "/api/tasks":
+            return c.list_tasks(int(params.get("limit", 1000))), 200
+        if route == "/api/placement_groups":
+            return c.list_placement_groups(), 200
+        if route == "/api/cluster_resources":
+            return c.cluster_resources(), 200
+        if route == "/api/available_resources":
+            return c.available_resources(), 200
+        return {"error": f"unknown route {route}"}, 404
+
+    def shutdown(self):
+        self._server.shutdown()
+        self._server.server_close()  # release the listening socket now
+        self._client.shutdown()

@@ -82,10 +82,9 @@ class HybridPolicy(SchedulingPolicy):
             k = d.tobytes()
             v = self._feas_cache.get(k)
             if v is None:
-                v = int((
-                    np.all(state.total + 1e-4 >= d[None, :], axis=1)
-                    & state.alive
-                ).sum())
+                v = kernel_np.feasible_node_count(
+                    state.total, state.alive, d
+                )
                 self._feas_cache[k] = v
             feas[i] = v
         return np.argsort(feas, kind="stable")

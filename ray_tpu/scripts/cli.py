@@ -194,6 +194,20 @@ def cmd_microbenchmark(args):
     perf_main(address=getattr(args, "address", None), quick=args.quick)
 
 
+def cmd_dashboard(args):
+    import time as _time
+
+    from ray_tpu.dashboard import DashboardHead
+
+    head = DashboardHead(_resolve_address(args), port=args.port)
+    print(f"dashboard serving at {head.url} (ctrl-c to stop)")
+    try:
+        while True:
+            _time.sleep(1)
+    except KeyboardInterrupt:
+        head.shutdown()
+
+
 def cmd_up(args):
     from ray_tpu.autoscaler.launcher import cluster_up
     from ray_tpu.util.usage import record_event
@@ -251,6 +265,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--address")
     sp.add_argument("--quick", action="store_true")
     sp.set_defaults(fn=cmd_microbenchmark)
+
+    sp = sub.add_parser("dashboard", help="serve cluster state over HTTP/JSON")
+    sp.add_argument("--address")
+    sp.add_argument("--port", type=int, default=8265)
+    sp.set_defaults(fn=cmd_dashboard)
 
     sp = sub.add_parser("up", help="launch a cluster from a YAML config")
     sp.add_argument("config", help="cluster YAML path")

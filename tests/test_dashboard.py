@@ -1,0 +1,72 @@
+"""Dashboard head: the HTTP/JSON state surface.
+
+Reference: python/ray/dashboard/modules/state/state_head.py routes.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster import Cluster
+from ray_tpu.dashboard import DashboardHead
+
+
+@pytest.fixture
+def dash():
+    c = Cluster()
+    c.add_node(num_cpus=2, node_id="dash-node")
+    c.wait_for_nodes(1)
+    ray_tpu.init(address=c.address)
+    head = DashboardHead(c.address)
+    yield head
+    head.shutdown()
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read()), r.status
+
+
+def test_dashboard_endpoints(dash):
+    @ray_tpu.remote
+    def work():
+        return 1
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return "pong"
+
+    assert ray_tpu.get(work.remote(), timeout=60) == 1
+    a = A.remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+
+    body, st = _get(dash.url + "/api/summary")
+    assert st == 200 and body["nodes_alive"] == 1
+
+    body, _ = _get(dash.url + "/api/nodes")
+    assert any(n["NodeID"] == "dash-node" for n in body)
+
+    body, _ = _get(dash.url + "/api/actors")
+    assert any(x["state"] == "ALIVE" for x in body)
+
+    body, _ = _get(dash.url + "/api/tasks?limit=10")
+    assert isinstance(body, list) and body
+
+    body, _ = _get(dash.url + "/api/cluster_resources")
+    assert body["CPU"] == 2.0
+
+    body, _ = _get(dash.url + "/")
+    assert "/api/summary" in body["endpoints"]
+
+
+def test_dashboard_unknown_route(dash):
+    import urllib.error
+
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(dash.url + "/api/nope")
+    assert ei.value.code == 404
