@@ -302,6 +302,16 @@ class ClusterClient:
                 batch.append(self._gc_queue.popleft())
             if not batch:
                 continue
+            # failed submissions drain here too (single thread, bounded):
+            # _fail_task_refs takes the lock and does blocking RPCs, so it
+            # runs outside the refcount pass below
+            fails = [p for k, p in batch if k == "fail_submit"]
+            batch = [(k, p) for k, p in batch if k != "fail_submit"]
+            for meta, msg in fails:
+                try:
+                    self._fail_task_refs(meta["task_id"], meta, msg)
+                except Exception:  # noqa: BLE001
+                    pass
             drop = []
             with self._lock:
                 for kind, oid in batch:
@@ -421,18 +431,20 @@ class ClusterClient:
                 exc = fut.exception()
             except Exception:  # noqa: BLE001 - cancelled
                 return
-            if exc is not None:
-                # off-thread: this callback fires on the gcs READER thread,
-                # where blocking RPCs (_publish_error -> daemon.call) are
-                # forbidden — they'd stall every push/result and, on
-                # connection loss with K pending submits, delay reconnect
-                # by K x the rpc timeout
-                threading.Thread(
-                    target=self._fail_task_refs,
-                    args=(meta["task_id"], meta,
-                          f"submission failed: {exc}"),
-                    daemon=True, name="submit-fail",
-                ).start()
+            if exc is None:
+                return
+            if isinstance(exc, ConnectionLost):
+                # connection loss is owned by the reconnect loop, which
+                # resubmits every unfinished task — failing the refs here
+                # would race it (error objects published over outputs a
+                # successful resubmission is about to produce)
+                return
+            # genuine server-side rejection: route through the single
+            # failure-drain thread (this callback fires on the gcs READER
+            # thread where blocking RPCs are forbidden, and one thread per
+            # failure would be a thread storm on bulk fan-out failures)
+            self._gc_queue.append(("fail_submit", (meta,
+                                                   f"submission failed: {exc}")))
 
         self.gcs.call_async("submit_task", meta).add_done_callback(_cb)
 

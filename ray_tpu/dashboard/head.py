@@ -30,9 +30,14 @@ class DashboardHead:
 
     def __init__(self, gcs_address: str, host: str = "127.0.0.1",
                  port: int = 0):
+        from ray_tpu.core.config import Config
         from ray_tpu.cluster.client import ClusterClient
 
-        self._client = ClusterClient(gcs_address)
+        # a state-only consumer: don't subscribe this process to the whole
+        # cluster's worker-log fanout
+        self._client = ClusterClient(
+            gcs_address, config=Config({"log_to_driver": False})
+        )
         head = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -44,12 +49,15 @@ class DashboardHead:
                     body, status = head._route(self.path)
                 except Exception as e:  # noqa: BLE001
                     body, status = {"error": repr(e)}, 500
-                data = json.dumps(body, default=str).encode()
-                self.send_response(status)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(data)))
-                self.end_headers()
-                self.wfile.write(data)
+                try:
+                    data = json.dumps(body, default=str).encode()
+                    self.send_response(status)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                except OSError:
+                    pass  # client hung up / head shutting down mid-request
 
         self._server = ThreadingHTTPServer((host, port), Handler)
         self.host = host

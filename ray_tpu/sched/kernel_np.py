@@ -343,15 +343,24 @@ def _sat_cumsum_f(x: np.ndarray, axis: int) -> np.ndarray:
     return cum.astype(np.float32)
 
 
+def feasible_node_counts(
+    total: np.ndarray, alive: np.ndarray, demands: np.ndarray
+) -> np.ndarray:
+    """[C] how many nodes could EVER host each demand (total capacity, not
+    current availability — stable across rounds). One [C, N, R] broadcast;
+    shared by the simulator and the live policy so their class orderings
+    can never diverge."""
+    return (
+        np.all(total[None, :, :] + EPS >= demands[:, None, :], axis=2)
+        & alive[None, :]
+    ).sum(axis=1)
+
+
 def feasible_node_count(
     total: np.ndarray, alive: np.ndarray, demand: np.ndarray
 ) -> int:
-    """How many nodes could EVER host this demand (total capacity, not
-    current availability — stable across rounds). Shared by the simulator
-    and the live policy so their class orderings can never diverge."""
-    return int(
-        (np.all(total + EPS >= demand[None, :], axis=1) & alive).sum()
-    )
+    """Single-demand case of feasible_node_counts (policy cache misses)."""
+    return int(feasible_node_counts(total, alive, demand[None, :])[0])
 
 
 def constrained_order(
@@ -363,11 +372,9 @@ def constrained_order(
     only-feasible nodes to flexible classes that could run anywhere.
     Measured effect: masked-feasibility makespan gap vs per-task greedy
     drops from ~5% to about -10% (bench config 3)."""
-    feas = np.array([
-        feasible_node_count(total, alive, demands[c])
-        for c in range(demands.shape[0])
-    ])
-    return np.argsort(feas, kind="stable")
+    return np.argsort(
+        feasible_node_counts(total, alive, demands), kind="stable"
+    )
 
 
 def spread_assign(
