@@ -148,6 +148,7 @@ class ClusterClient:
         self._is_worker_client = "RAY_TPU_WORKER_ID" in __import__("os").environ
         reply = self.gcs.call("register_driver", {
             "driver_id": self.worker_id, "worker": self._is_worker_client,
+            "logs": bool(self.config.log_to_driver),
         })
         self._nodes: Dict[str, dict] = reply["nodes"]
         self._put_rr = 0
@@ -370,6 +371,7 @@ class ClusterClient:
                 reply = gcs.call("register_driver", {
                     "driver_id": self.worker_id,
                     "worker": self._is_worker_client,
+                    "logs": bool(self.config.log_to_driver),
                 })
             except OSError:
                 continue
@@ -391,6 +393,24 @@ class ClusterClient:
                 except Exception:
                     pass
             return
+        # the GCS never came back: without this, every unfinished task's
+        # refs would hang forever (the submit callbacks deferred to us)
+        with self._lock:
+            stranded = [
+                dict(m) for tid, m in self._task_meta.items()
+                if not (m.get("actor_creation") or m.get("actor_id"))
+                and not self.store.contains(
+                    ObjectRef.for_task_output(tid, 0, owner=self.worker_id)
+                )
+            ]
+        for m in stranded:
+            try:
+                self._fail_task_refs(
+                    m["task_id"], m,
+                    "GCS unreachable past reconnect timeout",
+                )
+            except Exception:  # noqa: BLE001
+                pass
 
     # ----------------------------------------------------------- submission
 
@@ -433,11 +453,17 @@ class ClusterClient:
                 return
             if exc is None:
                 return
-            if isinstance(exc, ConnectionLost):
-                # connection loss is owned by the reconnect loop, which
-                # resubmits every unfinished task — failing the refs here
-                # would race it (error objects published over outputs a
-                # successful resubmission is about to produce)
+            if isinstance(exc, ConnectionLost) and not (
+                meta.get("actor_creation") or meta.get("actor_id")
+            ):
+                # connection loss on a NORMAL task is owned by the
+                # reconnect loop, which resubmits every unfinished task —
+                # failing the refs here would race it (error objects
+                # published over outputs a successful resubmission is about
+                # to produce). If the GCS never returns, the reconnect loop
+                # fails these tasks itself on timeout. Actor submissions are
+                # NOT resubmitted by that loop, so they fall through to the
+                # failure drain below.
                 return
             # genuine server-side rejection: route through the single
             # failure-drain thread (this callback fires on the gcs READER

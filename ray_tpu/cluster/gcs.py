@@ -274,6 +274,9 @@ class GcsServer:
             self.drivers[conn.conn_id] = {
                 "driver_id": p["driver_id"], "conn": conn,
                 "worker": bool(p.get("worker")),
+                # log fanout interest: state-only consumers (dashboard,
+                # log_to_driver=False drivers) are excluded server-side
+                "logs": bool(p.get("logs", True)),
             }
             conn.meta["driver_id"] = p["driver_id"]
             self.jobs[p["driver_id"]] = {
@@ -567,7 +570,7 @@ class GcsServer:
         with self._lock:
             driver_conn_ids = {
                 d["conn"].conn_id for d in self.drivers.values()
-                if not d.get("worker")
+                if not d.get("worker") and d.get("logs", True)
                 and (owner is None or d.get("driver_id") == owner)
             }
         if not driver_conn_ids:

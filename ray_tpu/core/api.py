@@ -67,6 +67,12 @@ def init(
                     "build"
                 ) from e
             _runtime = ClusterClient(address, config=config)
+        # opt-in tracing (reference: RAY_TRACING_ENABLED installing the
+        # span wrappers at init)
+        from ray_tpu.util import tracing as _tracing
+
+        if _tracing.tracing_enabled():
+            _tracing.enable_task_spans()
         return _runtime
 
 

@@ -1,0 +1,111 @@
+"""Tracing hookup: driver-side task spans + device profiler capture.
+
+Reference: python/ray/util/tracing/ (opt-in span wrappers around _remote
+when RAY_TRACING_ENABLED) and the dashboard's profiling hooks. Two pieces:
+
+- enable_task_spans(): monkey-wraps RemoteFunction.remote with span
+  bookkeeping; spans land in an in-process buffer exportable as
+  chrome-trace JSON (merges into the `ray_tpu timeline` view of the same
+  format).
+- profile_device(logdir): context manager around jax.profiler.trace — the
+  TPU-native replacement for py-spy/memray device-time profiling; view in
+  TensorBoard or xprof.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+# bounded ring: long-running traced drivers must not grow without limit
+_MAX_SPANS = 100_000
+from collections import deque  # noqa: E402
+
+_spans: "deque" = deque(maxlen=_MAX_SPANS)
+_lock = threading.Lock()
+_installed = False
+
+
+def tracing_enabled() -> bool:
+    return os.environ.get("RAY_TPU_TRACING_ENABLED", "0").lower() in (
+        "1", "true", "yes", "on"
+    )
+
+
+def record_span(name: str, start: float, end: float, **meta) -> None:
+    with _lock:
+        _spans.append({
+            "name": name, "ph": "X", "pid": os.getpid(),
+            "tid": threading.get_ident() % 1_000_000,
+            "ts": start * 1e6, "dur": (end - start) * 1e6, "args": meta,
+        })
+
+
+def get_spans() -> List[Dict[str, Any]]:
+    with _lock:
+        return list(_spans)
+
+
+def clear_spans() -> None:
+    with _lock:
+        _spans.clear()
+
+
+def export_chrome_trace(path: str) -> str:
+    """Write collected spans as a chrome://tracing JSON array — the SAME
+    top-level shape `ray_tpu timeline` emits (util/state/timeline.py), so
+    the two files merge by list concatenation."""
+    with open(path, "w") as f:
+        json.dump(get_spans(), f)
+    return path
+
+
+def enable_task_spans() -> None:
+    """Wrap RemoteFunction.remote with submit spans (idempotent).
+    Reference: the _remote monkey-wrap in python/ray/util/tracing/."""
+    global _installed
+    if _installed:
+        return
+    from ray_tpu.core import api
+
+    orig = api.RemoteFunction.remote
+
+    def traced(self, *args, **kwargs):
+        t0 = time.time()
+        out = orig(self, *args, **kwargs)
+        record_span(
+            f"submit:{getattr(self._func, '__name__', 'task')}",
+            t0, time.time(),
+        )
+        return out
+
+    api.RemoteFunction.remote = traced
+    _installed = True
+
+
+@contextlib.contextmanager
+def span(name: str, **meta):
+    """User-facing span context manager."""
+    t0 = time.time()
+    try:
+        yield
+    finally:
+        record_span(name, t0, time.time(), **meta)
+
+
+@contextlib.contextmanager
+def profile_device(logdir: str):
+    """Capture a JAX/XLA device profile (TPU-native analog of the
+    dashboard's py-spy flamegraphs): `with profile_device('/tmp/prof'):`
+    then inspect with TensorBoard's profile plugin / xprof."""
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield logdir
+    finally:
+        jax.profiler.stop_trace()
