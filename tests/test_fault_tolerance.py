@@ -303,3 +303,67 @@ def test_actor_results_survive_worker_restart():
     finally:
         ray_tpu.shutdown()
         cluster.shutdown()
+
+
+def test_gcs_restart_resets_bundle_capacity(tmp_path):
+    """A GCS restart must restore CREATED placement groups with FULL bundle
+    capacity: pre-crash debits belong to a running table that is not
+    persisted, so carrying them over would wedge the bundle forever
+    (regression test for the round-4 restore-path fix)."""
+    from ray_tpu.util.placement_group import placement_group
+    from ray_tpu.util.scheduling_strategies import (
+        PlacementGroupSchedulingStrategy,
+    )
+
+    persist = str(tmp_path / "gcs_tables.pkl")
+    cluster = Cluster(persistence_path=persist)
+    cluster.add_node(num_cpus=4)
+    ray_tpu.init(address=cluster.address)
+    try:
+        pg = placement_group([{"CPU": 2}], strategy="PACK")
+        assert pg.ready(timeout=30)
+        strat = PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_bundle_index=0
+        )
+
+        @ray_tpu.remote(num_cpus=2)
+        def burn():
+            return "pre-restart"
+
+        assert ray_tpu.get(
+            burn.options(scheduling_strategy=strat).remote(), timeout=60
+        ) == "pre-restart"
+        # debit the bundle, snapshot while debited, then CRASH the GCS.
+        # The crash must be non-graceful: a graceful shutdown re-persists
+        # after _on_disconnect demotes the PG (daemon conns closing), which
+        # would overwrite this fixture and bypass the restore branch under
+        # test. Disabling persistence after the snapshot models SIGKILL.
+        with cluster.gcs._lock:
+            rec = cluster.gcs.placement_groups[pg.id]
+            rec["bundle_avail"][0] = rec["bundle_avail"][0] * 0.0
+        cluster.gcs._persist_now()
+        cluster.gcs.persistence_path = None  # no further writes (crash)
+        cluster.restart_gcs()
+
+        with cluster.gcs._lock:
+            rec = cluster.gcs.placement_groups[pg.id]
+            assert rec["state"] == "CREATED"
+            # capacity reset to the bundle total on restore
+            assert float(rec["bundle_avail"][0][0]) == 2.0
+
+        # a bundle task runs again after the restart (no wedged capacity)
+        deadline = time.time() + 60
+        out = None
+        while time.time() < deadline:
+            try:
+                out = ray_tpu.get(
+                    burn.options(scheduling_strategy=strat).remote(),
+                    timeout=15,
+                )
+                break
+            except Exception:
+                time.sleep(0.5)
+        assert out == "pre-restart"
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
