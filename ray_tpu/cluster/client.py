@@ -6,6 +6,12 @@ mode-agnostic. Fills the submitter half of the reference's core worker
 (src/ray/core_worker/core_worker.cc SubmitTask/Get + task_manager.cc retries
 and lineage; transport/normal_task_submitter.cc lease reuse is subsumed by
 the GCS's centralized batched rounds — see cluster/__init__.py).
+
+This ALSO absorbs the reference's Ray Client (python/ray/util/client/ —
+the `ray.init("ray://host:port")` remote-driver proxy): every driver here
+is already a remote client over plain TCP, so no separate proxy
+server/stub layer is needed. `init(address="ray_tpu://host:port")` is
+accepted for symmetry (_parse_address strips the scheme).
 """
 
 from __future__ import annotations
