@@ -18,7 +18,7 @@ _DEFS: Dict[str, tuple] = {
     "scheduler_spread_threshold": (float, 0.5),
     "scheduler_top_k_fraction": (float, 0.2),  # reserved; kernel is deterministic
     "scheduling_policy": (str, "hybrid"),  # hybrid | jax_tpu | spread | random
-    "scheduler_kernel_algo": (str, "scan"),  # "scan" | "rounds" batched kernel
+    "scheduler_kernel_algo": (str, "scan"),  # "scan" | "rounds" | "chunked"
     "scheduler_round_interval_ms": (float, 2.0),
     "max_direct_call_object_size": (int, 100 * 1024),  # inline-in-reply threshold
     "worker_lease_timeout_ms": (float, 500.0),

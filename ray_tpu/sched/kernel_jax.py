@@ -83,12 +83,27 @@ def _fill_by_bucket(cap, bucket, remaining, n_buckets=SCORE_BUCKETS):
     # cumsum runs along lanes instead of sublanes.
     onehot = (bucket[None, :] == jnp.arange(n_buckets)[:, None]).astype(jnp.float32)
     contrib = onehot * capf[None, :]  # [B, N]
-    within_incl = jnp.cumsum(contrib, axis=1)  # prefix inside each bucket
-    bucket_tot = within_incl[:, -1]  # [B]
-    bucket_off = jnp.concatenate(
-        [jnp.zeros((1,), jnp.float32), jnp.cumsum(bucket_tot)[:-1]]
+    # Saturating associative scans, NOT jnp.cumsum: XLA lowers cumsum to a
+    # quadratic reduce-window on TPU (profiled at 72 of 89 ms/round at
+    # N=10240 — 81% of the whole scan kernel). The EXCLUSIVE prefix must be
+    # scanned directly over a shifted input — subtracting contrib from a
+    # saturated inclusive scan is unsound (SAT - contrib can fall back under
+    # `remaining`). With both prefix components saturated at SAT=2**23 and
+    # remaining < 2**23 (pad_problem's assert): any saturated component
+    # forces prev >= SAT > remaining => take=0, and wherever the true
+    # prefix < remaining nothing saturated, so partials are exact in f32 —
+    # decisions stay bit-identical to the NumPy twin's int64 path.
+    shifted = jnp.concatenate(
+        [jnp.zeros((n_buckets, 1), jnp.float32), contrib[:, :-1]], axis=1
     )
-    prev_mat = bucket_off[:, None] + within_incl - contrib  # exclusive prefix
+    within_excl = _sat_cumsum(shifted, axis=1)  # exclusive prefix per bucket
+    bucket_tot = jnp.minimum(
+        within_excl[:, -1] + contrib[:, -1], jnp.float32(SAT)
+    )  # [B]
+    bucket_off = jnp.concatenate(
+        [jnp.zeros((1,), jnp.float32), _sat_cumsum(bucket_tot, axis=0)[:-1]]
+    )
+    prev_mat = bucket_off[:, None] + within_excl  # each term <= SAT: exact
     prev = (prev_mat * onehot).sum(axis=0)  # [N]
     take = jnp.clip(jnp.float32(remaining) - prev, 0.0, capf)
     return take.astype(jnp.int32)
@@ -196,51 +211,15 @@ def _sat_cumsum(x, axis):
     )
 
 
-@functools.partial(jax.jit, static_argnames=("rounds", "active_idx"))
-def schedule_classes_rounds(
-    avail: jnp.ndarray,
-    total: jnp.ndarray,
-    alive: jnp.ndarray,
-    demands: jnp.ndarray,
-    counts: jnp.ndarray,
-    spread_threshold: float = DEFAULT_SPREAD_THRESHOLD,
-    rounds: int = 4,
-    active_idx: Optional[tuple] = None,
-) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Fully-parallel variant of schedule_classes: all classes are placed by
-    [C, N] matrix passes instead of a per-class sequential scan (whose
-    ~0.4ms/class op latency dominated the 1M-task round).
+def _rounds_core(avail, total, alive_f, demands, counts, thr, rounds, active):
+    """Two-phase [C, N] matrix placement over `rounds` global rounds — the
+    shared core of schedule_classes_rounds (C = whole queue) and
+    schedule_classes_chunked (C = one chunk). Pure code motion from the
+    original schedule_classes_rounds body; decisions are bit-identical.
 
-    Per global round, two phases (A: fill nodes only up to the spread
-    threshold; B: equal-share the overflow across feasible nodes). Each phase:
-      1. every class prefix-fills its capacity caps in node-index order
-         (exact fill via saturating-scan cumsum — no sort, no permutation
-         gathers: those dominated the round cost on TPU, and for phase A
-         index order IS sorted order since only under-threshold/bucket-0
-         nodes have nonzero cap);
-      2. conflicts are resolved by class-priority: a class sees the
-         *claimed* usage of lower-indexed classes via a saturating cumsum
-         over C, and trims its take to the remaining headroom — so the result
-         is feasible by construction and close to sequentially scheduling
-         classes in index order.
-
-    NumPy twin: kernel_np.schedule_classes_rounds (bit-identical decisions;
-    golden-tested). Exactness bounds: per-class counts < 2**23 (asserted in
-    pad_problem) and integer-granular demands; fractional or >2**24-magnitude
-    resource amounts may diverge between backends by +-1 task at boundaries.
-
-    active_idx: static tuple of resource columns any class actually demands
-    (host-computed). The [C, N] passes loop only over those columns — with
-    the usual 3-4 live resources that's a 4-5x cut in HBM traffic vs the
-    padded 16-wide resource dim. None -> all columns.
-    """
-    thr = jnp.float32(spread_threshold)
-    avail = avail.astype(jnp.float32)
-    demands = demands.astype(jnp.float32)
+    Returns (assigned [C, N] float32, avail [N, R] float32)."""
     C, R = demands.shape
     N = avail.shape[0]
-    alive_f = alive.astype(jnp.float32)
-    active = tuple(range(R)) if active_idx is None else tuple(active_idx)
     # compressed views: only the demanded resource columns
     d_act = [demands[:, r] for r in active]  # each [C]
 
@@ -320,7 +299,115 @@ def schedule_classes_rounds(
     (avail, remaining, assigned), _ = jax.lax.scan(
         one_round, (avail, remaining, assigned), None, length=rounds
     )
+    return assigned, avail
+
+
+@functools.partial(jax.jit, static_argnames=("rounds", "active_idx"))
+def schedule_classes_rounds(
+    avail: jnp.ndarray,
+    total: jnp.ndarray,
+    alive: jnp.ndarray,
+    demands: jnp.ndarray,
+    counts: jnp.ndarray,
+    spread_threshold: float = DEFAULT_SPREAD_THRESHOLD,
+    rounds: int = 4,
+    active_idx: Optional[tuple] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Fully-parallel variant of schedule_classes: all classes are placed by
+    [C, N] matrix passes instead of a per-class sequential scan (whose
+    ~0.4ms/class op latency dominated the 1M-task round).
+
+    Per global round, two phases (A: fill nodes only up to the spread
+    threshold; B: equal-share the overflow across feasible nodes). Each phase:
+      1. every class prefix-fills its capacity caps in node-index order
+         (exact fill via saturating-scan cumsum — no sort, no permutation
+         gathers: those dominated the round cost on TPU, and for phase A
+         index order IS sorted order since only under-threshold/bucket-0
+         nodes have nonzero cap);
+      2. conflicts are resolved by class-priority: a class sees the
+         *claimed* usage of lower-indexed classes via a saturating cumsum
+         over C, and trims its take to the remaining headroom — so the result
+         is feasible by construction and close to sequentially scheduling
+         classes in index order.
+
+    NumPy twin: kernel_np.schedule_classes_rounds (bit-identical decisions;
+    golden-tested). Exactness bounds: per-class counts < 2**23 (asserted in
+    pad_problem) and integer-granular demands; fractional or >2**24-magnitude
+    resource amounts may diverge between backends by +-1 task at boundaries.
+
+    active_idx: static tuple of resource columns any class actually demands
+    (host-computed). The [C, N] passes loop only over those columns — with
+    the usual 3-4 live resources that's a 4-5x cut in HBM traffic vs the
+    padded 16-wide resource dim. None -> all columns.
+    """
+    thr = jnp.float32(spread_threshold)
+    avail = avail.astype(jnp.float32)
+    demands = demands.astype(jnp.float32)
+    C, R = demands.shape
+    active = tuple(range(R)) if active_idx is None else tuple(active_idx)
+    assigned, avail = _rounds_core(
+        avail, total, alive.astype(jnp.float32), demands, counts, thr, rounds,
+        active,
+    )
     return assigned.astype(jnp.int32), avail
+
+
+@functools.partial(
+    jax.jit, static_argnames=("chunk", "rounds", "active_idx")
+)
+def schedule_classes_chunked(
+    avail: jnp.ndarray,
+    total: jnp.ndarray,
+    alive: jnp.ndarray,
+    demands: jnp.ndarray,
+    counts: jnp.ndarray,
+    spread_threshold: float = DEFAULT_SPREAD_THRESHOLD,
+    chunk: int = 16,
+    rounds: int = 2,
+    active_idx: Optional[tuple] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Chunked middle ground between the sequential `scan` kernel (256
+    dependent steps, one class each) and the fully-parallel `rounds` kernel
+    (one step, [C, N] matrices, 4 global rounds): a `lax.scan` over C/chunk
+    chunks of `chunk` classes, each placed by `_rounds_core`'s two-phase
+    fill with class-priority conflict resolution WITHIN the chunk.
+
+    Why: the scan kernel's cost is 256 x (while_loop pass latency) — almost
+    entirely sequential-step overhead at [N, R] sizes too small to fill the
+    VPU; the rounds kernel pays for full-width [256, N] matrices 4 times
+    over. Chunking cuts sequential depth 16x while keeping slabs at
+    [16, N] — and availability still updates *between* chunks, so placement
+    quality tracks the sequential kernel far closer than global rounds does
+    (most-constrained-first ordering puts the classes that care about
+    ordering in the earliest chunks). Quality is bounded by the makespan
+    simulator (bench configs 1-3), same as every kernel here.
+
+    NumPy twin: kernel_np.schedule_classes_chunked (golden-tested decision
+    equality; integer-granular demands, counts < 2**23 as usual). C must be
+    a multiple of `chunk` — pad_problem's buckets (16/64/256/1024/4096) all
+    are.
+    """
+    thr = jnp.float32(spread_threshold)
+    avail = avail.astype(jnp.float32)
+    demands = demands.astype(jnp.float32)
+    C, R = demands.shape
+    N = avail.shape[0]
+    if C % chunk:
+        raise ValueError(f"class dim {C} not a multiple of chunk {chunk}")
+    alive_f = alive.astype(jnp.float32)
+    active = tuple(range(R)) if active_idx is None else tuple(active_idx)
+    dg = demands.reshape(C // chunk, chunk, R)
+    kg = counts.reshape(C // chunk, chunk)
+
+    def step(avail, xs):
+        d, k = xs
+        assigned, avail = _rounds_core(
+            avail, total, alive_f, d, k, thr, rounds, active
+        )
+        return avail, assigned
+
+    avail, assigned = jax.lax.scan(step, avail, (dg, kg))
+    return assigned.reshape(C, N).astype(jnp.int32), avail
 
 
 def pad_problem(
@@ -413,17 +500,40 @@ class JaxScheduler:
                  algo: str = "scan") -> np.ndarray:
         pad = bucket_size(demands.shape[0])
         d, k = pad_problem(np.asarray(demands, np.float32), np.asarray(counts), pad)
-        if algo == "rounds":
+        if algo in ("rounds", "chunked"):
+            # padded classes demand INF_FIT of resource 0, so they are inert
+            # in the matrix passes, but resource 0 must stay in the active
+            # set for that guard to execute
             active = tuple(int(i) for i in np.flatnonzero((d > 0).any(axis=0)))
-            assigned, new_avail = schedule_classes_rounds(
-                self.avail, self.total, self.alive,
-                jnp.asarray(d), jnp.asarray(k), spread_threshold,
-                active_idx=active,
-            )
+            if algo == "chunked":
+                assigned, new_avail = schedule_classes_chunked(
+                    self.avail, self.total, self.alive,
+                    jnp.asarray(d), jnp.asarray(k), spread_threshold,
+                    active_idx=active,
+                )
+            else:
+                assigned, new_avail = schedule_classes_rounds(
+                    self.avail, self.total, self.alive,
+                    jnp.asarray(d), jnp.asarray(k), spread_threshold,
+                    active_idx=active,
+                )
         else:
             assigned, new_avail = schedule_classes(
                 self.avail, self.total, self.alive,
                 jnp.asarray(d), jnp.asarray(k), spread_threshold,
             )
         self.avail = new_avail
-        return np.asarray(assigned[: demands.shape[0]])
+        out = assigned[: demands.shape[0]]
+        if out.shape[0] == 0:
+            return np.asarray(out)
+        # Narrow-dtype device->host transfer: the dense [C, N] int32 result
+        # is the round's dominant host link cost (10.5MB at 256x10240; the
+        # axon tunnel has been measured as low as ~35MB/s). Per-cell counts
+        # are almost always tiny, so downcast on device when a scalar max
+        # check (4-byte sync) proves it lossless — 4x/2x less on the wire.
+        m = int(out.max())
+        if m < 256:
+            return np.asarray(out.astype(jnp.uint8)).astype(np.int32)
+        if m < 32768:
+            return np.asarray(out.astype(jnp.int16)).astype(np.int32)
+        return np.asarray(out)

@@ -335,6 +335,37 @@ def schedule_classes_rounds(
     return assigned.astype(np.int32), avail
 
 
+def schedule_classes_chunked(
+    avail: np.ndarray,
+    total: np.ndarray,
+    alive: np.ndarray,
+    demands: np.ndarray,
+    counts: np.ndarray,
+    spread_threshold: float = DEFAULT_SPREAD_THRESHOLD,
+    chunk: int = 16,
+    rounds: int = 2,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """NumPy twin of kernel_jax.schedule_classes_chunked: classes are placed
+    `chunk` at a time by the two-phase rounds core, with availability carried
+    between chunks (sequential at chunk granularity, parallel within). See
+    the jax docstring for rationale; golden-tested decision equality on
+    integer-granular problems. A trailing partial chunk is allowed here (the
+    jax path pads instead)."""
+    avail = avail.astype(np.float32).copy()
+    C = demands.shape[0]
+    out = []
+    for s in range(0, C, chunk):
+        a, avail = schedule_classes_rounds(
+            avail, total, alive,
+            demands[s : s + chunk], counts[s : s + chunk],
+            spread_threshold, rounds,
+        )
+        out.append(a)
+    if not out:
+        return np.zeros((0, avail.shape[0]), np.int32), avail
+    return np.concatenate(out, axis=0), avail
+
+
 def _sat_cumsum_f(x: np.ndarray, axis: int) -> np.ndarray:
     """Saturating cumsum over possibly-fractional nonnegative float32 values.
     Sequential semantics = min(prefix, SAT); exact (and equal to the jax

@@ -143,6 +143,12 @@ class HybridPolicy(SchedulingPolicy):
                 demands_o, counts_o,
                 spread_threshold=self.spread_threshold,
             )
+        elif self.algo == "chunked":
+            assigned, new_avail = kernel_np.schedule_classes_chunked(
+                state.available, state.total, state.alive,
+                demands_o, counts_o,
+                spread_threshold=self.spread_threshold,
+            )
         else:
             assigned, new_avail = kernel_np.schedule_classes(
                 state.available, state.total, state.alive,

@@ -105,6 +105,11 @@ def _batched_round(
             avail, total, alive, demands, counts,
             spread_threshold=spread_threshold,
         )
+    elif algo == "chunked":
+        assigned, new_avail = kernel_np.schedule_classes_chunked(
+            avail, total, alive, demands, counts,
+            spread_threshold=spread_threshold,
+        )
     else:
         assigned, new_avail = kernel_np.schedule_classes(
             avail, total, alive, demands, counts,
@@ -144,7 +149,7 @@ def simulate_makespan(
       durations: per-class int arrays, durations[c][i] = ticks for the i-th
         task of class c (consumed FIFO — both schedulers hand tasks out in
         class order, so task i of class c gets the same duration under both).
-      scheduler: "greedy" | "classes" | "rounds".
+      scheduler: "greedy" | "classes" | "rounds" | "chunked".
       jax_sched: optional kernel_jax.JaxScheduler to run the batched kernels
         on device (its avail view must start equal to `total*alive`).
     """

@@ -1,0 +1,109 @@
+"""Tests for the chunked kernel (lax.scan over chunks of the rounds core)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu.sched import kernel_np
+from ray_tpu.sched.resources import pack_demands
+
+from tests.test_sched_kernel import make_state
+from tests.test_sched_rounds import _random_problem
+
+
+def test_chunked_respects_capacity():
+    st, demands, counts = _random_problem(3, N=64, C=13)
+    assigned, avail = kernel_np.schedule_classes_chunked(
+        st.available, st.total, st.alive, demands, counts, chunk=4
+    )
+    assert (assigned.sum(axis=1) <= counts).all()
+    assert (avail >= -1e-3).all()
+    used = (assigned.astype(np.float32).T @ demands)
+    assert (used <= st.available + 1e-2).all()
+
+
+def test_chunked_places_when_feasible():
+    st = make_state([{"CPU": 16}] * 4)
+    demands = pack_demands(st.space, [{"CPU": 1}])
+    counts = np.array([40], dtype=np.int32)
+    assigned, _ = kernel_np.schedule_classes_chunked(
+        st.available, st.total, st.alive, demands, counts, chunk=16
+    )
+    assert assigned.sum() == 40
+
+
+def test_chunked_chunk1_matches_rounds_per_class():
+    """chunk=1 degenerates to per-class sequential rounds placement."""
+    st, demands, counts = _random_problem(5, N=48, C=6)
+    chunked, _ = kernel_np.schedule_classes_chunked(
+        st.available, st.total, st.alive, demands, counts, chunk=1, rounds=4
+    )
+    avail = st.available.copy()
+    rows = []
+    for c in range(len(counts)):
+        a, avail = kernel_np.schedule_classes_rounds(
+            avail, st.total, st.alive, demands[c : c + 1], counts[c : c + 1],
+            rounds=4,
+        )
+        rows.append(a)
+    np.testing.assert_array_equal(chunked, np.concatenate(rows, axis=0))
+
+
+def test_chunked_quality_close_to_sequential():
+    """Chunked must place nearly as many tasks as the sequential scan kernel
+    (placed-count proxy; the makespan simulator bounds the rest)."""
+    for seed in range(5):
+        st, demands, counts = _random_problem(seed, N=128, C=12)
+        seq, _ = kernel_np.schedule_classes(
+            st.available, st.total, st.alive, demands, counts
+        )
+        chk, _ = kernel_np.schedule_classes_chunked(
+            st.available, st.total, st.alive, demands, counts, chunk=4
+        )
+        # 0.95 rather than the rounds kernel's 0.97: these raw-kernel
+        # problems skip the policy's constrained-first ordering, and a
+        # constrained class split across chunk boundaries can lose its only
+        # nodes to an earlier chunk; the makespan simulator (bench configs
+        # 1-3) is the authoritative quality gate.
+        assert chk.sum() >= 0.95 * seq.sum(), (seed, int(chk.sum()), int(seq.sum()))
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_chunked_np_jax_golden_equality(seed):
+    import jax.numpy as jnp
+    from ray_tpu.sched import kernel_jax
+
+    st, demands, counts = _random_problem(seed, N=96, C=9)
+    # jax path requires C % chunk == 0: pad with inert classes the same way
+    # JaxScheduler.schedule does via pad_problem
+    d, k = kernel_jax.pad_problem(demands, counts, 12)
+    np_assigned, np_avail = kernel_np.schedule_classes_chunked(
+        st.available, st.total, st.alive, d, k, chunk=4
+    )
+    jx_assigned, jx_avail = kernel_jax.schedule_classes_chunked(
+        jnp.asarray(st.available), jnp.asarray(st.total), jnp.asarray(st.alive),
+        jnp.asarray(d), jnp.asarray(k), chunk=4,
+    )
+    np.testing.assert_array_equal(np_assigned, np.asarray(jx_assigned))
+    np.testing.assert_allclose(np_avail, np.asarray(jx_avail), atol=1e-2)
+
+
+def test_chunked_via_scheduler_wrapper():
+    """JaxScheduler.schedule(algo='chunked') pads, runs, and unpads."""
+    from ray_tpu.sched.kernel_jax import JaxScheduler
+
+    st, demands, counts = _random_problem(7, N=32, C=5)
+    sched = JaxScheduler(st.total, st.alive)
+    sched.set_available(st.available)
+    assigned = sched.schedule(demands, counts, algo="chunked")
+    ref, _ = kernel_np.schedule_classes_chunked(
+        st.available, st.total, st.alive,
+        *kernel_jax_pad(demands, counts), chunk=16,
+    )
+    np.testing.assert_array_equal(assigned, ref[: len(counts)])
+
+
+def kernel_jax_pad(demands, counts):
+    from ray_tpu.sched import kernel_jax
+
+    pad = kernel_jax.bucket_size(demands.shape[0])
+    return kernel_jax.pad_problem(demands, counts, pad)

@@ -98,7 +98,7 @@ def test_makespan_gap_small_homogeneous():
     assert gap["makespan_gap_pct"] <= 3.0, gap
 
 
-@pytest.mark.parametrize("scheduler", ["classes", "rounds"])
+@pytest.mark.parametrize("scheduler", ["classes", "rounds", "chunked"])
 def test_makespan_gap_small_heterogeneous(scheduler):
     # Config-2 shape (scaled down): mixed {cpu, mem} classes, heterogeneous
     # nodes, multiple waves.
@@ -142,7 +142,7 @@ def test_dead_nodes_excluded():
     assert res.unplaced == 0
 
 
-@pytest.mark.parametrize("scheduler", ["classes", "rounds"])
+@pytest.mark.parametrize("scheduler", ["classes", "rounds", "chunked"])
 def test_makespan_gap_contended(scheduler):
     # target_waves forces real contention (~4 full waves through the
     # cluster) — the regime where placement quality shows up in makespan.
