@@ -274,9 +274,14 @@ def config_5(dev):
     }
 
 
-def gcs_loop_bench(policy_name, n_tasks=20_000, n_nodes=64):
+def gcs_loop_bench(policy_name, n_tasks=20_000, n_nodes=64,
+                   min_cells=None):
     """End-to-end decisions/s through a live GcsServer: submit via rpc,
-    schedule via _schedule_round, drain completions between rounds."""
+    schedule via _schedule_round, drain completions between rounds.
+
+    min_cells: None = the shipped jax_tpu behavior (small rounds run on
+    the bit-identical NumPy twin, jax_policy_min_cells default); 0 forces
+    every round onto the device — the kernel-in-the-loop measurement."""
     from ray_tpu.core.config import Config
     from ray_tpu.cluster.gcs import GcsServer
     from ray_tpu.cluster.testing import (
@@ -286,10 +291,13 @@ def gcs_loop_bench(policy_name, n_tasks=20_000, n_nodes=64):
         run_rounds_to_quiescence,
     )
 
-    gcs = GcsServer(config=Config({
+    cfg = {
         "scheduling_policy": policy_name,
         "scheduler_round_interval_ms": 60_000.0,
-    }))
+    }
+    if min_cells is not None:
+        cfg["jax_policy_min_cells"] = min_cells
+    gcs = GcsServer(config=Config(cfg))
     park_scheduler_loop(gcs)
     try:
         rng = np.random.default_rng(6)
@@ -457,6 +465,15 @@ def main():
     t0 = time.time()
     configs["gcs_loop_jax"] = gcs_loop_bench("jax_tpu")
     log(f"gcs jax {configs['gcs_loop_jax']} ({time.time()-t0:.1f}s)")
+
+    # device path forced (jax_policy_min_cells=0): measures the kernel in
+    # the live loop; fewer tasks — per-round device dispatch through the
+    # axon tunnel can cost 100ms+ when the link is degraded
+    t0 = time.time()
+    configs["gcs_loop_jax_device"] = gcs_loop_bench(
+        "jax_tpu", n_tasks=5_000, min_cells=0
+    )
+    log(f"gcs jax device {configs['gcs_loop_jax_device']} ({time.time()-t0:.1f}s)")
 
     t0 = time.time()
     configs["cluster_mode"] = cluster_mode_bench()

@@ -19,6 +19,10 @@ _DEFS: Dict[str, tuple] = {
     "scheduler_top_k_fraction": (float, 0.2),  # reserved; kernel is deterministic
     "scheduling_policy": (str, "hybrid"),  # hybrid | jax_tpu | spread | random
     "scheduler_kernel_algo": (str, "scan"),  # "scan" | "rounds" | "chunked"
+    # jax_tpu policy: rounds smaller than this many classes*nodes cells run
+    # on the bit-identical NumPy twin (device dispatch latency dominates
+    # small solves); 0 = always use the device
+    "jax_policy_min_cells": (int, 262_144),
     "scheduler_round_interval_ms": (float, 2.0),
     "max_direct_call_object_size": (int, 100 * 1024),  # inline-in-reply threshold
     "worker_lease_timeout_ms": (float, 500.0),

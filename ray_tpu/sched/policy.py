@@ -55,10 +55,16 @@ class HybridPolicy(SchedulingPolicy):
     FULL_SYNC_INTERVAL = 64
 
     def __init__(self, spread_threshold: float = 0.5, backend: str = "numpy",
-                 algo: str = "scan"):
+                 algo: str = "scan", device_min_cells: int = 262_144):
         self.spread_threshold = spread_threshold
         self.backend = backend
         self.algo = algo
+        # jax backend only: problems below this many [classes x nodes]
+        # cells run on the bit-identical NumPy twin instead — a device
+        # dispatch (worse: a tunneled one) costs more than the whole
+        # solve at small sizes, and the live GCS schedules MANY small
+        # rounds between big ones. 0 forces every round onto the device.
+        self.device_min_cells = device_min_cells
         self._jax = None  # lazily built JaxScheduler (topology-dependent)
         self._topology_key = None
         self._rounds_since_full_sync = 0
@@ -125,7 +131,11 @@ class HybridPolicy(SchedulingPolicy):
         inv[order] = np.arange(len(order))
         demands_o = demands[order]
         counts_o = np.asarray(counts)[order]
-        if self.backend == "jax":
+        use_device = (
+            self.backend == "jax"
+            and demands.shape[0] * len(state.node_ids) >= self.device_min_cells
+        )
+        if use_device:
             sched = self._jax_sched(state)
             self._rounds_since_full_sync += 1
             assigned = sched.schedule(
@@ -137,6 +147,11 @@ class HybridPolicy(SchedulingPolicy):
             taken = assigned.astype(np.float32).T @ demands  # [N, R]
             state.available = np.maximum(state.available - taken, 0.0)
             return assigned
+        if self.backend == "jax":
+            # small round on the NumPy twin: the device availability cache
+            # goes stale, so force a full re-upload before the next
+            # device-sized round
+            self._rounds_since_full_sync = self.FULL_SYNC_INTERVAL
         if self.algo == "rounds":
             assigned, new_avail = kernel_np.schedule_classes_rounds(
                 state.available, state.total, state.alive,
@@ -262,6 +277,7 @@ def make_policy_from_config(config) -> SchedulingPolicy:
     if name in ("hybrid", "jax_tpu"):
         kw["spread_threshold"] = config.scheduler_spread_threshold
         kw["algo"] = config.scheduler_kernel_algo
+        kw["device_min_cells"] = config.jax_policy_min_cells
     return make_policy(name, **kw)
 
 

@@ -35,6 +35,9 @@ def _boot_gcs(policy_name, n_nodes=64, algo="scan"):
             "scheduling_policy": policy_name,
             "scheduler_kernel_algo": algo,
             "scheduler_round_interval_ms": 60_000.0,
+            # force the device path: these tests exist to exercise the
+            # kernel inside the live GCS even at toy sizes
+            "jax_policy_min_cells": 0,
         })
     )
     park_scheduler_loop(gcs)
@@ -141,7 +144,7 @@ def test_policy_incremental_sync_equality():
         st_a.add_node(f"n{i}", r)
         st_b.add_node(f"n{i}", r)
     pol_np = make_policy_from_config(Config({"scheduling_policy": "hybrid"}))
-    pol_jx = make_policy_from_config(Config({"scheduling_policy": "jax_tpu"}))
+    pol_jx = make_policy_from_config(Config({"scheduling_policy": "jax_tpu", "jax_policy_min_cells": 0}))
     for rnd in range(12):
         demands = np.zeros((3, 16), np.float32)
         demands[:, 0] = rng.integers(1, 4, 3)

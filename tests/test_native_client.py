@@ -1,0 +1,144 @@
+"""Native C++ client + head job/call gateway.
+
+Reference parity rows: the C++ worker API (cpp/src/ray/) via the
+cross-language named-call path, and REST job submission
+(dashboard/modules/job/job_head.py).
+"""
+
+import ctypes
+import json
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster import Cluster
+from ray_tpu.dashboard import DashboardHead
+
+
+@pytest.fixture(scope="module")
+def dash():
+    c = Cluster()
+    c.add_node(num_cpus=2, node_id="nc-node")
+    c.wait_for_nodes(1)
+    ray_tpu.init(address=c.address)
+    head = DashboardHead(c.address)
+    yield head
+    head.shutdown()
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+@pytest.fixture(scope="module")
+def lib():
+    from ray_tpu._native import load_library
+
+    lib = load_library("native_client")
+    for fn in ("rt_get", "rt_post", "rt_call", "rt_submit_job"):
+        getattr(lib, fn).restype = ctypes.c_void_p
+    lib.rt_free.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def _take(lib, ptr):
+    assert ptr, "native client returned NULL"
+    try:
+        return json.loads(ctypes.string_at(ptr).decode())
+    finally:
+        lib.rt_free(ptr)
+
+
+def test_native_get_state(dash, lib):
+    out = _take(lib, lib.rt_get(b"127.0.0.1", dash.port, b"/api/summary"))
+    assert out["nodes_alive"] == 1
+    out = _take(lib, lib.rt_get(b"127.0.0.1", dash.port, b"/api/nodes"))
+    assert any(n["NodeID"] == "nc-node" for n in out)
+
+
+def test_native_call_runs_cluster_task(dash, lib):
+    body = json.dumps(
+        {"func": "math:hypot", "args": [3, 4], "timeout": 60}
+    ).encode()
+    out = _take(lib, lib.rt_call(b"127.0.0.1", dash.port, body))
+    assert out == {"result": 5.0}
+
+
+def test_native_call_kwargs_and_error(dash, lib):
+    body = json.dumps(
+        {"func": "builtins:int", "args": ["ff"], "kwargs": {"base": 16}}
+    ).encode()
+    out = _take(lib, lib.rt_call(b"127.0.0.1", dash.port, body))
+    assert out == {"result": 255}
+
+    body = json.dumps({"func": "builtins:int", "args": ["nope"]}).encode()
+    out = _take(lib, lib.rt_call(b"127.0.0.1", dash.port, body))
+    assert "error" in out
+
+
+def test_native_job_submit_status_logs(dash, lib):
+    script = (
+        "import os, math, ray_tpu; "
+        "ray_tpu.init(address=os.environ['RAY_TPU_ADDRESS']); "
+        "f = ray_tpu.remote(math.sqrt); "
+        "print('job-result', ray_tpu.get(f.remote(49.0), timeout=60)); "
+        "ray_tpu.shutdown()"
+    )
+    body = json.dumps(
+        {"entrypoint": f'{sys.executable} -c "{script}"'}
+    ).encode()
+    out = _take(lib, lib.rt_submit_job(b"127.0.0.1", dash.port, body))
+    jid = out["job_id"]
+    assert out["status"] in ("RUNNING", "SUCCEEDED")
+
+    deadline = time.time() + 120
+    status = None
+    while time.time() < deadline:
+        st = _take(
+            lib, lib.rt_get(b"127.0.0.1", dash.port, f"/api/jobs/{jid}".encode())
+        )
+        status = st["status"]
+        if status not in ("RUNNING",):
+            break
+        time.sleep(0.5)
+    assert status == "SUCCEEDED", st
+
+    logs = _take(
+        lib,
+        lib.rt_get(b"127.0.0.1", dash.port, f"/api/jobs/{jid}/logs".encode()),
+    )
+    assert "job-result 7" in logs["logs"]
+
+    listing = _take(lib, lib.rt_get(b"127.0.0.1", dash.port, b"/api/jobs"))
+    assert any(j["job_id"] == jid for j in listing)
+
+
+def test_bad_submission_id_rejected(dash, lib):
+    body = json.dumps(
+        {"entrypoint": "true", "submission_id": "../../etc/escape"}
+    ).encode()
+    out = _take(lib, lib.rt_submit_job(b"127.0.0.1", dash.port, body))
+    assert "error" in out and "submission_id" in out["error"]
+
+
+def test_job_stop(dash, lib):
+    body = json.dumps(
+        {"entrypoint": f"{sys.executable} -c 'import time; time.sleep(300)'"}
+    ).encode()
+    out = _take(lib, lib.rt_submit_job(b"127.0.0.1", dash.port, body))
+    jid = out["job_id"]
+    out = _take(
+        lib,
+        lib.rt_post(
+            b"127.0.0.1", dash.port, f"/api/jobs/{jid}/stop".encode(), b"{}"
+        ),
+    )
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        st = _take(
+            lib, lib.rt_get(b"127.0.0.1", dash.port, f"/api/jobs/{jid}".encode())
+        )
+        if st["status"] != "RUNNING":
+            break
+        time.sleep(0.3)
+    assert st["status"] in ("STOPPED", "FAILED")
