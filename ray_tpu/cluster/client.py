@@ -128,6 +128,14 @@ class ClusterClient:
         # (oid, borrower) until the borrower releases it or dies.
         self._refcounts: Dict[str, list] = {}  # oid -> [local, pinned]
         self._borrows: Dict[str, set] = {}  # oid -> {borrower worker_ids}
+        # output ids of THIS client's in-flight ACTOR calls. Actor calls
+        # bypass the GCS (direct client->daemon dispatch), so the GCS's
+        # active_outputs can't know a producer exists; deps carrying
+        # own_inflight=True tell its gate "pending, not dead" (reference
+        # analog: the owner resolves args locally before scheduling in
+        # normal_task_submitter.cc — here the gate is remote, so the
+        # ownership knowledge travels with the spec)
+        self._inflight_outputs: set = set()
         # A borrow_released can arrive BEFORE its borrow_added: the add rides
         # the direct daemon reply while the release rides the GCS push
         # connection — different reader threads, no ordering. Early releases
@@ -427,6 +435,8 @@ class ClusterClient:
         ]
         if spec.actor_id is not None and not spec.actor_creation:
             meta = self._make_meta(spec)
+            with self._lock:
+                self._inflight_outputs.update(r.id for r in refs)
             self._track_submission(spec.task_id, meta, refs)
             self._submit_actor_call_meta(spec.actor_id, meta, refs)
             return refs
@@ -444,6 +454,20 @@ class ClusterClient:
         self._submit_async(meta)
         return refs
 
+    def _refresh_inflight_deps(self, meta: dict) -> None:
+        """Recompute own_inflight vouchers against the CURRENT in-flight
+        set at every (re)submission. The stored meta is reused by retries
+        and lineage repair, possibly long after the vouched-for actor call
+        completed — a stale voucher would make the GCS dep-gate park the
+        consumer forever instead of declaring the dep lost."""
+        with self._lock:
+            inflight = self._inflight_outputs
+            for d in meta.get("deps") or ():
+                if d["id"] in inflight:
+                    d["own_inflight"] = True
+                else:
+                    d.pop("own_inflight", None)
+
     def _submit_async(self, meta: dict) -> None:
         """Async submit: the ack carries nothing the client uses on success
         (deps-lost outcomes also arrive as task_result pushes), and one
@@ -452,6 +476,7 @@ class ClusterClient:
         task_result will ever arrive — fail the refs (including publishing
         the error object so dependents waiting at the GCS dep gate unblock
         and raise instead of hanging)."""
+        self._refresh_inflight_deps(meta)
         def _cb(fut, meta=meta):
             try:
                 exc = fut.exception()
@@ -527,19 +552,27 @@ class ClusterClient:
                 "method_name": spec.method_name,
             })
         deps = []
+        with self._lock:
+            inflight = set(self._inflight_outputs)
         for a in list(spec.args) + list(spec.kwargs.values()):
             if isinstance(a, ObjectRef):
-                deps.append({
+                d = {
                     "id": a.id,
                     # producing task, for owner-side lineage reconstruction
                     "task": a.task_id or self._ref_index.get(a.id),
-                })
+                }
+                if a.id in inflight:
+                    d["own_inflight"] = True
+                deps.append(d)
         for ref in nested.values():
-            deps.append({
+            d = {
                 "id": ref.id,
                 "task": ref.task_id or self._ref_index.get(ref.id),
                 "nested": True,
-            })
+            }
+            if ref.id in inflight:
+                d["own_inflight"] = True
+            deps.append(d)
         return {
             "task_id": spec.task_id,
             "name": spec.name,
@@ -636,6 +669,7 @@ class ClusterClient:
             def fail(err, refs=refs, meta=meta):
                 for r in refs:
                     self.store.put(r, err, is_exception=True)
+                self._finalize_actor_call(refs, err)
                 self._release_task_deps(meta["task_id"])
 
             try:
@@ -666,26 +700,25 @@ class ClusterClient:
                     # daemon died with the call possibly mid-execution:
                     # at-most-once — fail, never replay (reference: actor
                     # calls in flight at death get ActorDiedError)
+                    err = ActorDiedError(f"actor node unreachable: {e}")
                     for r in refs:
-                        self.store.put(
-                            r, ActorDiedError(f"actor node unreachable: {e}"),
-                            is_exception=True,
-                        )
+                        self.store.put(r, err, is_exception=True)
+                    self._finalize_actor_call(refs, err)
                     self._release_task_deps(meta["task_id"])
                     return
                 except Exception as e:  # noqa: BLE001
+                    err = TaskError(f"actor call failed: {e!r}")
                     for r in refs:
-                        self.store.put(
-                            r, TaskError(f"actor call failed: {e!r}"),
-                            is_exception=True,
-                        )
+                        self.store.put(r, err, is_exception=True)
+                    self._finalize_actor_call(refs, err)
                     self._release_task_deps(meta["task_id"])
                     return
                 if p.get("status") == "ACTOR_UNREACHABLE" and \
                         self._maybe_replay_actor_call(actor_id, seq, meta, refs):
                     return
                 self._apply_borrows(p)
-                self._ingest_result(p, refs)
+                err = self._ingest_result(p, refs)
+                self._finalize_actor_call(refs, err)
                 self._release_task_deps(meta["task_id"])
 
             fut.add_done_callback(on_done)
@@ -798,8 +831,13 @@ class ClusterClient:
             self.store.put(r, err, is_exception=True)
         # publish the error as the objects themselves so tasks waiting on
         # these outputs fail with it instead of hanging at the dependency
-        # gate (reference: the owner stores the error object)
-        self._publish_error(refs, err)
+        # gate (reference: the owner stores the error object). On a side
+        # thread: this is reached from rpc reader/callback threads, and
+        # _publish_error retries with backoff
+        threading.Thread(
+            target=self._publish_error, args=(refs, err),
+            daemon=True, name="task-err-publish",
+        ).start()
         self._release_task_deps(task_id)
 
     def _repair_and_resubmit(self, meta: dict, lost_deps: List[dict]) -> None:
@@ -847,6 +885,7 @@ class ClusterClient:
                             continue  # another consumer already resubmitted
                         self._reconstructing.add(ptid)
                     try:
+                        self._refresh_inflight_deps(pmeta)
                         self.gcs.call("submit_task", pmeta)
                     except Exception:
                         # leave the door open for a later repair attempt
@@ -864,27 +903,61 @@ class ClusterClient:
                 # transfer, not a failure — don't charge the retry budget
                 meta["_dep_refunds"] = meta.get("_dep_refunds", 0) + 1
                 meta["retries_left"] = meta.get("retries_left", 0) + 1
+            self._refresh_inflight_deps(meta)
             self.gcs.call("submit_task", meta)
         except Exception as e:  # noqa: BLE001
             self._fail_task_refs(meta["task_id"], meta, f"lineage repair: {e!r}")
 
     def _publish_error(self, refs: List[ObjectRef], err: BaseException) -> None:
         """Write an exception payload into the cluster store under each
-        ref's id, so dependents waiting on them unblock and raise."""
+        ref's id, so dependents waiting on them unblock and raise.
+
+        Retries across re-picked nodes: consumers parked at the GCS gate on
+        an own_inflight voucher have ONLY this publication to wake them, so
+        best-effort isn't good enough. (Residual risk if no node accepts
+        within the window: those consumers stay parked until the next
+        node-death sweep re-evaluates them.)"""
         payload = serialization.pack({"e": True, "v": err})
-        node = self._pick_put_node()
-        if node is None:
-            return
-        try:
-            daemon = self._daemon(node["node_id"], node["addr"], node["port"])
+        pending = list(refs)
+        deadline = time.time() + 15.0
+        while pending and time.time() < deadline:
+            node = self._pick_put_node()
+            if node is None:
+                time.sleep(0.5)
+                continue
+            try:
+                daemon = self._daemon(node["node_id"], node["addr"], node["port"])
+                for r in list(pending):
+                    daemon.call(
+                        "put_object", {"object_id": r.id, "payload": payload}
+                    )
+                    pending.remove(r)
+            except Exception:  # noqa: BLE001 - node bounced: re-pick
+                time.sleep(0.5)
+
+    def _finalize_actor_call(self, refs: List[ObjectRef],
+                             err: Optional[BaseException] = None) -> None:
+        """Close out an actor call's output refs: drop them from the
+        in-flight set (the GCS dep-gate flag source), and on failure
+        publish the error AS the objects so cluster-side consumers parked
+        on them wake up and raise instead of waiting forever. Publication
+        runs on its own thread — this is called from rpc reader/callback
+        threads, where blocking daemon calls are forbidden."""
+        with self._lock:
             for r in refs:
-                daemon.call("put_object", {"object_id": r.id, "payload": payload})
-        except Exception:  # noqa: BLE001
-            pass
+                self._inflight_outputs.discard(r.id)
+        if err is not None:
+            threading.Thread(
+                target=self._publish_error, args=(list(refs), err),
+                daemon=True, name="actor-err-publish",
+            ).start()
 
     def _ingest_result(self, p: dict, refs: List[ObjectRef]):
+        """Record a call's results locally; returns the error stored for
+        failed calls (None on success) so callers can propagate it."""
         inline = p.get("inline", {})
         result_ids = {oid for oid, _ in p.get("results", [])}
+        err = None
         for r in refs:
             if r.id in inline:
                 rec = serialization.unpack(inline[r.id])
@@ -900,11 +973,9 @@ class ClusterClient:
                     if p.get("status") in ("ACTOR_DEAD", "ACTOR_UNREACHABLE")
                     else TaskError
                 )
-                self.store.put(
-                    r,
-                    err_cls(f"task failed: {p.get('error')}"),
-                    is_exception=True,
-                )
+                err = err_cls(f"task failed: {p.get('error')}")
+                self.store.put(r, err, is_exception=True)
+        return err
 
     # --------------------------------------------------------------- objects
 

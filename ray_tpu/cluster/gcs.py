@@ -305,10 +305,15 @@ class GcsServer:
                 if a is not None:
                     a["creation_meta"] = dict(p)
             missing = self._missing_deps(p)
+            # own_inflight: the owner vouches an in-flight ACTOR call of its
+            # own will produce this object (actor calls bypass the GCS, so
+            # active_outputs can't see them) — park, don't declare dead; a
+            # failed call publishes the error AS the object, waking waiters
             dead = [
                 d for d in (p.get("deps") or ())
                 if d["id"] in missing
                 and self.active_outputs.get(d["id"], 0) == 0
+                and not d.get("own_inflight")
             ]
             if dead:
                 # no copy anywhere and nothing queued will produce it: hand
@@ -410,6 +415,12 @@ class GcsServer:
             if w is None:
                 continue
             w["missing"].discard(oid)
+            for d in w["meta"].get("deps") or ():
+                if d["id"] == oid:
+                    # one-shot: own_inflight vouched for the object only
+                    # until first produced — once seen, a later loss means
+                    # lost-for-real (hand back, don't wait forever)
+                    d.pop("own_inflight", None)
             if not w["missing"]:
                 del self.waiting_tasks[tid]
                 self.pending.append(w["meta"])
@@ -1066,6 +1077,7 @@ class GcsServer:
                     d for d in (t.get("deps") or ())
                     if d["id"] in missing
                     and self.active_outputs.get(d["id"], 0) == 0
+                    and not d.get("own_inflight")  # see rpc_submit_task
                 ]
                 if dead_deps:
                     self._track_exit(t)
@@ -1073,6 +1085,11 @@ class GcsServer:
                 else:
                     self._enqueue_waiting(t, missing)
                 continue
+            # every dep exists at this point: retire one-shot own_inflight
+            # vouchers (see _on_object_added) before the task enters the
+            # run queues
+            for d in t.get("deps") or ():
+                d.pop("own_inflight", None)
             self._queued_ids.add(tid)
             if t.get("strategy", {}).get("kind") in (
                 "NODE_AFFINITY", "PLACEMENT_GROUP", "NODE_LABEL"
@@ -1463,6 +1480,9 @@ class GcsServer:
                     d for d in (meta.get("deps") or ())
                     if self.active_outputs.get(d["id"], 0) == 0
                     and d["id"] not in will_return
+                    and not d.get("own_inflight")  # producer is a live
+                    # actor call the GCS can't see; its owner publishes an
+                    # error object on failure, so waiters can't hang
                     and not any(
                         self.nodes.get(nid, {}).get("alive")
                         for nid in self.directory.get(d["id"], ())
@@ -1511,6 +1531,7 @@ class GcsServer:
                     d for d in (w["meta"].get("deps") or ())
                     if self.active_outputs.get(d["id"], 0) == 0
                     and d["id"] not in will_return
+                    and not d.get("own_inflight")  # see _dead_deps_of
                     and not any(
                         self.nodes.get(nid, {}).get("alive")
                         for nid in self.directory.get(d["id"], ())

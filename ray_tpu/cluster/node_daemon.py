@@ -303,6 +303,15 @@ class NodeDaemon:
                 gcs = self._connect_gcs()
             except OSError:
                 continue
+            if self._stopped:
+                # stop() raced the reconnect: a stopping daemon must not
+                # resurrect itself (it would re-register as alive with its
+                # store contents, then silently heartbeat-timeout again)
+                try:
+                    gcs.close()
+                except Exception:  # noqa: BLE001
+                    pass
+                return
             # re-sync node-local state into the fresh GCS tables
             with self._lock:
                 actor_ids = [

@@ -23,6 +23,7 @@ from __future__ import annotations
 import json
 import os
 import re
+import signal
 import subprocess
 import tempfile
 import threading
@@ -188,7 +189,15 @@ class DashboardHead:
             if j is None:  # unknown or still spawning
                 return {"error": f"no job {jid}"}, 404
             if j["proc"].poll() is None:
-                j["proc"].terminate()
+                # kill the whole process GROUP: terminate() signals only the
+                # shell, orphaning compound entrypoints ("a && b", pipelines)
+                # while status would read STOPPED. start_new_session
+                # guarantees pgid == proc.pid. (reference: job_manager.py
+                # kills the job's process group too)
+                try:
+                    os.killpg(j["proc"].pid, signal.SIGTERM)
+                except (ProcessLookupError, PermissionError):
+                    j["proc"].terminate()
             return self._job_view(j), 200
         if route == "/api/call":
             return self._gateway_call(payload)
@@ -211,10 +220,17 @@ class DashboardHead:
         # reserve the id under the lock; fork/exec outside it (a spawn can
         # be slow and must not serialize submissions or block /stop)
         with self._jobs_lock:
-            self._job_seq += 1
-            jid = sub_id or f"job-{self._job_seq:04d}"
-            if jid in self._jobs:
-                return {"error": f"job {jid} already exists"}, 400
+            if sub_id:
+                jid = sub_id
+                if jid in self._jobs:
+                    return {"error": f"job {jid} already exists"}, 400
+            else:
+                # skip auto ids a user-chosen submission_id already took
+                while True:
+                    self._job_seq += 1
+                    jid = f"job-{self._job_seq:04d}"
+                    if jid not in self._jobs:
+                        break
             self._jobs[jid] = None  # placeholder: id is taken
         env = dict(os.environ)
         env.update({str(k): str(v) for k, v in (payload.get("env") or {}).items()})

@@ -72,8 +72,13 @@ def moe_ffn(
     gate = jnp.max(probs, axis=-1)  # [G,S]
     onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)  # [G,S,E]
 
-    # position of each token within its expert's queue; drop past capacity
-    pos = jnp.cumsum(onehot, axis=1) * onehot - 1.0  # [G,S,E], -1 if not routed
+    # position of each token within its expert's queue; drop past capacity.
+    # associative_scan, not jnp.cumsum: XLA lowers cumsum to a quadratic
+    # reduce-window on TPU (O(S^2) over the sequence axis; measured 81% of
+    # a kernel's runtime in the scheduler before the same fix)
+    pos = (
+        jax.lax.associative_scan(jnp.add, onehot, axis=1) * onehot - 1.0
+    )  # [G,S,E], -1 if not routed
     keep = (pos >= 0) & (pos < C)
     dispatch = keep[..., None] * jax.nn.one_hot(
         jnp.clip(pos, 0, C - 1).astype(jnp.int32), C, dtype=jnp.float32

@@ -30,7 +30,7 @@ the worker.
 
 from __future__ import annotations
 
-from typing import Any, Dict, Hashable, List, Sequence, Union
+from typing import Any, Dict, Hashable, List, Optional, Sequence, Union
 
 import ray_tpu
 from ray_tpu.core.object_ref import ObjectRef
@@ -99,41 +99,72 @@ def _exec_graph_task(fn, tree, *vals):
     return fn(*_fill_slots(tree, vals))
 
 
-def _submit_graph(dsk: Dict[Key, Any]) -> Dict[Key, Any]:
-    """Submit every graph node once; returns key -> ObjectRef (tasks) or
-    resolved structure (literal / alias nodes)."""
-    produced: Dict[Key, Any] = {}
-    visiting: set = set()
+def _key_deps(dsk: Dict[Key, Any], comp: Any, acc: List[Key]) -> None:
+    """Collect key references inside a computation (recursion depth is
+    bounded by literal nesting, not by graph depth)."""
+    if ishashable(comp) and comp in dsk:
+        acc.append(comp)
+        return
+    if istask(comp):
+        for a in comp[1:]:
+            _key_deps(dsk, a, acc)
+    elif isinstance(comp, (list, tuple)):
+        for a in comp:
+            _key_deps(dsk, a, acc)
+    elif isinstance(comp, dict):
+        for v in comp.values():
+            _key_deps(dsk, v, acc)
 
-    def resolve(comp: Any) -> Any:
+
+def _submit_graph(
+    dsk: Dict[Key, Any], targets: Optional[List[Key]] = None
+) -> Dict[Key, Any]:
+    """Submit each graph node reachable from `targets` (default: all keys)
+    exactly once; returns key -> ObjectRef (tasks) or resolved structure
+    (literal / alias nodes). Iterative DFS — deep linear chains (thousands
+    of sequential nodes, routine for generated graphs) must not hit the
+    interpreter recursion limit, and unreachable subgraphs must not burn
+    cluster time (dask relies on cull() for this; here it's built in)."""
+    produced: Dict[Key, Any] = {}
+    on_stack: set = set()
+
+    def build(comp: Any) -> Any:
+        # key deps are all in `produced` by post-order; recursion here only
+        # descends literal nesting
         if ishashable(comp) and comp in dsk:
-            return node(comp)  # key reference (dask rule: keys shadow literals)
+            return produced[comp]  # dask rule: keys shadow equal literals
         if istask(comp):
             fn = comp[0]
-            args = tuple(resolve(a) for a in comp[1:])
+            args = tuple(build(a) for a in comp[1:])
             tree, refs = _extract_refs(args)
             return _exec_graph_task.remote(fn, tree, *refs)
         if isinstance(comp, (list, tuple)):
-            return type(comp)(resolve(a) for a in comp)
+            return type(comp)(build(a) for a in comp)
         if isinstance(comp, dict):
             # slightly more permissive than dask (which treats dict
             # literals as opaque): key references in dict VALUES resolve
-            return {k: resolve(v) for k, v in comp.items()}
+            return {k: build(v) for k, v in comp.items()}
         return comp
 
-    def node(key: Key) -> Any:
+    roots = list(dsk) if targets is None else targets
+    stack: List[tuple] = [(k, False) for k in reversed(roots)]
+    while stack:
+        key, expanded = stack.pop()
         if key in produced:
-            return produced[key]
-        if key in visiting:
+            continue
+        if expanded:
+            on_stack.discard(key)
+            produced[key] = build(dsk[key])
+            continue
+        if key in on_stack:
             raise ValueError(f"cycle in graph at key {key!r}")
-        visiting.add(key)
-        out = resolve(dsk[key])
-        visiting.discard(key)
-        produced[key] = out
-        return out
-
-    for k in dsk:
-        node(k)
+        on_stack.add(key)
+        stack.append((key, True))
+        acc: List[Key] = []
+        _key_deps(dsk, dsk[key], acc)
+        for d in acc:
+            if d not in produced:
+                stack.append((d, False))
     return produced
 
 
@@ -146,8 +177,19 @@ def get(
 
     `keys` may be a single key or a (possibly nested) list of keys; the
     result mirrors its shape (dask passes e.g. [[k1, k2]] for collections).
+    Only nodes reachable from `keys` are submitted (built-in cull).
     """
-    produced = _submit_graph(dsk)
+    targets: List[Key] = []
+
+    def collect(k):
+        if isinstance(k, list):
+            for x in k:
+                collect(x)
+        elif ishashable(k) and k in dsk:
+            targets.append(k)
+
+    collect(keys if isinstance(keys, list) else [keys])
+    produced = _submit_graph(dsk, targets)
 
     def fetch(v):
         if isinstance(v, ObjectRef):

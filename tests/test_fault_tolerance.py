@@ -367,3 +367,148 @@ def test_gcs_restart_resets_bundle_capacity(tmp_path):
     finally:
         ray_tpu.shutdown()
         cluster.shutdown()
+
+
+def test_actor_predeath_results_lost_with_node_raise_cleanly():
+    """Node death is the unrecoverable case for actor results: actor method
+    results are NOT lineage-reconstructable (reference semantics:
+    ObjectLostError unless max_task_retries re-executes), so a get of a
+    pre-death result whose only copy died with the node must raise a clear
+    error — not hang. Companion to test_actor_results_survive_worker_restart
+    (worker death keeps results: the node store outlives the worker)."""
+    import numpy as np
+
+    cluster = Cluster()
+    cluster.add_node(num_cpus=2)
+    victim = cluster.add_node(num_cpus=2, resources={"victim": 1})
+    ray_tpu.init(address=cluster.address)
+    try:
+        @ray_tpu.remote(max_restarts=1, resources={"victim": 0.001})
+        class Producer:
+            def big(self):
+                return np.arange(300_000)  # shm-resident, not inlined
+
+            def ping(self):
+                return "pong"
+
+        a = Producer.remote()
+        ref = a.big.remote()
+
+        # confirm the result exists via a consumer task — NOT a driver get,
+        # which would cache the value in the driver's memory store and
+        # (correctly) satisfy the later get from that copy. The consumer
+        # is pinned to the victim too: running it elsewhere would peer-
+        # fetch a second, surviving copy (also correct behavior, but not
+        # the case under test).
+        @ray_tpu.remote(resources={"victim": 0.001})
+        def tail(arr):
+            return int(arr[-1])
+
+        assert ray_tpu.get(tail.remote(ref), timeout=30) == 299_999
+        victim_id = victim.node_id
+        cluster.kill_node(victim)
+        cluster.add_node(num_cpus=2, resources={"victim": 1, "fresh": 1})
+        # the GCS declares the node dead on heartbeat timeout — wait for it
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if not cluster.gcs.nodes.get(victim_id, {}).get("alive"):
+                break
+            time.sleep(0.2)
+        assert not cluster.gcs.nodes[victim_id]["alive"], "node never died"
+        # actor comes back on the replacement node
+        deadline = time.time() + 30
+        alive = False
+        while time.time() < deadline:
+            try:
+                alive = ray_tpu.get(a.ping.remote(), timeout=5.0) == "pong"
+                if alive:
+                    break
+            except Exception:
+                time.sleep(0.2)
+        assert alive, "actor did not restart after node death"
+        # every cluster copy died with the node: the directory drains to
+        # empty (poll — a racing daemon-reconnect can resurrect the node
+        # for one heartbeat interval before timing out again)
+        rt = ray_tpu.core.api._get_runtime()
+        deadline = time.time() + 30
+        loc = None
+        while time.time() < deadline:
+            loc = rt.gcs.call("locate_object", {"object_id": ref.id})
+            if not loc.get("nodes"):
+                break
+            time.sleep(0.5)
+        assert not loc.get("nodes"), f"directory kept a dead-node location: {loc}"
+        # a consumer needing it as an arg fails with a clear error — actor
+        # results are not lineage-reconstructable (reference semantics) —
+        # rather than hanging. (A driver-local get may still succeed on
+        # this single-host test rig: the victim's shm segment outlives its
+        # daemon process. Real node death has no such copy.)
+        with pytest.raises(Exception) as ei:
+            ray_tpu.get(tail.options(resources={"fresh": 0.001}).remote(ref),
+                        timeout=30.0)
+        assert any(
+            s in type(ei.value).__name__
+            for s in ("ObjectLost", "GetTimeout", "TaskError")
+        ), ei.value
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_consumer_task_waits_for_inflight_actor_result():
+    """A task consuming a STILL-COMPUTING actor call's result must park at
+    the dependency gate, not be declared deps-lost: actor calls bypass the
+    GCS, so the owner vouches for its own in-flight outputs
+    (deps[own_inflight], one-shot until first produced)."""
+    import numpy as np
+
+    cluster = Cluster()
+    cluster.add_node(num_cpus=2)
+    ray_tpu.init(address=cluster.address)
+    try:
+        @ray_tpu.remote
+        class Slow:
+            def make(self):
+                time.sleep(2.0)
+                return np.arange(200_000)  # shm-resident
+
+        a = Slow.remote()
+        ref = a.make.remote()
+
+        @ray_tpu.remote
+        def tail(arr):
+            return int(arr[-1])
+
+        # submitted immediately, while the actor method is still running
+        assert ray_tpu.get(tail.remote(ref), timeout=60) == 199_999
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_consumer_fails_cleanly_when_actor_dies_before_producing():
+    """If the vouched-for actor dies before producing, the owner publishes
+    the error AS the object — the parked consumer raises instead of
+    hanging at the gate."""
+    cluster = Cluster()
+    cluster.add_node(num_cpus=2)
+    ray_tpu.init(address=cluster.address)
+    try:
+        @ray_tpu.remote
+        class Doomed:
+            def make(self):
+                time.sleep(2.0)
+                os._exit(1)  # dies mid-call; max_restarts=0
+
+        a = Doomed.remote()
+        ref = a.make.remote()
+
+        @ray_tpu.remote
+        def ident(x):
+            return x
+
+        with pytest.raises(Exception):
+            ray_tpu.get(ident.remote(ref), timeout=40)
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()

@@ -94,18 +94,42 @@ def test_cycle_detected(local_ray):
 
 
 def test_shared_node_submitted_once(local_ray):
+    # local mode executes in-process, so a side-effect counter observes
+    # how many times the shared node's function actually ran
     calls = []
 
-    @ray_tpu.remote
-    def probe():
-        return 1
+    def counted(x):
+        calls.append(x)
+        return x + 1
 
-    # count via a literal side-channel isn't possible across workers in
-    # cluster mode, but in local mode the executor memoizes by key: the
-    # same ObjectRef object must be reused for both consumers
-    dsk = {"a": (inc, 0), "l": (inc, "a"), "r": (inc, "a")}
-    produced = graph._submit_graph(dsk)
-    assert produced["a"] is not None
-    # both consumers reference the same upstream ref (one submission)
+    dsk = {"a": (counted, 0), "l": (inc, "a"), "r": (inc, "a")}
     assert graph.get(dsk, ["l", "r"]) == [2, 2]
-    assert len({id(produced["a"])}) == 1
+    assert calls == [0], calls
+
+
+def test_cull_skips_unreachable_subgraph(local_ray):
+    ran = []
+
+    def tracked(tag):
+        ran.append(tag)
+        return tag
+
+    dsk = {
+        "wanted": (tracked, "w"),
+        "expensive_unused": (tracked, "skip-me"),
+        "out": (inc_len, "wanted"),
+    }
+    assert graph.get(dsk, "out") == 2
+    assert "skip-me" not in ran
+
+
+def inc_len(s):
+    return len(s) + 1
+
+
+def test_deep_linear_chain_no_recursion_error(local_ray):
+    n = 3000  # far past the default interpreter recursion limit
+    dsk = {"k0": 0}
+    for i in range(1, n):
+        dsk[f"k{i}"] = (inc, f"k{i - 1}")
+    assert graph.get(dsk, f"k{n - 1}") == n - 1
