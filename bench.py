@@ -275,7 +275,7 @@ def config_5(dev):
 
 
 def gcs_loop_bench(policy_name, n_tasks=20_000, n_nodes=64,
-                   min_cells=None):
+                   min_cells=None, n_classes=4):
     """End-to-end decisions/s through a live GcsServer: submit via rpc,
     schedule via _schedule_round, drain completions between rounds.
 
@@ -304,7 +304,7 @@ def gcs_loop_bench(policy_name, n_tasks=20_000, n_nodes=64,
         cpus = rng.integers(16, 65, n_nodes)
         register_fake_nodes(gcs, n_nodes, lambda i: {"CPU": int(cpus[i])})
         conn = FakeConn(999)
-        cpu = rng.integers(1, 5, n_tasks)
+        cpu = rng.integers(1, n_classes + 1, n_tasks)
         t0 = time.perf_counter()
         for i in range(n_tasks):
             gcs.rpc_submit_task(
@@ -466,12 +466,14 @@ def main():
     configs["gcs_loop_jax"] = gcs_loop_bench("jax_tpu")
     log(f"gcs jax {configs['gcs_loop_jax']} ({time.time()-t0:.1f}s)")
 
-    # device path forced (jax_policy_min_cells=0): measures the kernel in
-    # the live loop; fewer tasks — per-round device dispatch through the
-    # axon tunnel can cost 100ms+ when the link is degraded
+    # device-in-the-live-loop at the scale the device path exists for:
+    # 4096 nodes x 64 scheduling classes = 262k cells per round, which the
+    # SHIPPED jax_policy_min_cells threshold routes onto the TPU. (Forcing
+    # min_cells=0 at 64 nodes measured per-dispatch tunnel latency, not the
+    # scheduler: ~1s/round of overhead on tiny matrices.)
     t0 = time.time()
     configs["gcs_loop_jax_device"] = gcs_loop_bench(
-        "jax_tpu", n_tasks=5_000, min_cells=0
+        "jax_tpu", n_tasks=20_000, n_nodes=4096, n_classes=64
     )
     log(f"gcs jax device {configs['gcs_loop_jax_device']} ({time.time()-t0:.1f}s)")
 

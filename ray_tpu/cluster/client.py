@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import threading
 import time
-from collections import defaultdict, deque
+from collections import OrderedDict, defaultdict, deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -136,6 +136,22 @@ class ClusterClient:
         # normal_task_submitter.cc — here the gate is remote, so the
         # ownership knowledge travels with the spec)
         self._inflight_outputs: set = set()
+        # pickled-function cache: cloudpickling a dynamic function costs
+        # ~2ms, and doing it PER TASK capped driver submission at ~550/s
+        # (profiled: 3.2s of a 7s 1500-task submit loop). The reference
+        # exports a function definition once per cluster
+        # (function_manager.py export) — same idea here: pickle once per
+        # function object, ship the cached bytes in every spec. Closure-
+        # captured ObjectRefs are remembered alongside so every task still
+        # lists them as deps. id() keys are kept alive by the stored func
+        # reference. FIFO-capped.
+        self._func_cache: "OrderedDict[int, tuple]" = OrderedDict()
+        self._FUNC_CACHE_MAX = 512
+        # error-object publication queue: one shared publisher thread (see
+        # _publish_error); entries are (refs, payload, deadline)
+        self._err_pub_q: list = []
+        self._err_pub_cv = threading.Condition()
+        self._err_pub_thread: Optional[threading.Thread] = None
         # A borrow_released can arrive BEFORE its borrow_added: the add rides
         # the direct daemon reply while the release rides the GCS push
         # connection — different reader threads, no ordering. Early releases
@@ -403,6 +419,7 @@ class ClusterClient:
             self.gcs = gcs
             for meta in unfinished:
                 try:
+                    self._refresh_inflight_deps(meta)
                     gcs.call("submit_task", meta)
                 except Exception:
                     pass
@@ -456,15 +473,23 @@ class ClusterClient:
 
     def _refresh_inflight_deps(self, meta: dict) -> None:
         """Recompute own_inflight vouchers against the CURRENT in-flight
-        set at every (re)submission. The stored meta is reused by retries
-        and lineage repair, possibly long after the vouched-for actor call
-        completed — a stale voucher would make the GCS dep-gate park the
-        consumer forever instead of declaring the dep lost."""
+        set at every (re)submission — the SINGLE source of vouchers (every
+        GCS submit path runs through this: _submit_async, lineage repair's
+        two direct submits, the reconnect resubmit). The stored meta is
+        reused by retries and repair, possibly long after the vouched-for
+        actor call completed — a stale voucher would make the GCS dep-gate
+        park the consumer forever instead of declaring the dep lost.
+
+        The voucher value is the submission TIMESTAMP: the GCS honors it
+        as a lease (config own_inflight_lease_s) so a consumer whose owner
+        never manages to publish the failed call's error object is
+        eventually re-evaluated by a node-death sweep rather than parked
+        forever."""
         with self._lock:
             inflight = self._inflight_outputs
             for d in meta.get("deps") or ():
                 if d["id"] in inflight:
-                    d["own_inflight"] = True
+                    d["own_inflight"] = time.time()
                 else:
                     d.pop("own_inflight", None)
 
@@ -523,6 +548,31 @@ class ClusterClient:
         for r in refs:
             self._register_ref(r)
 
+    def _pickle_func(self, func):
+        """Pickle a task function/class once and reuse the bytes (see
+        _func_cache comment). Returns (bytes_or_None, closure_refs).
+
+        Matches the reference's export-once semantics: changes to globals a
+        dynamic function reads are frozen at first submission."""
+        if func is None:
+            return None, ()
+        from ray_tpu.core.object_ref import capture_refs
+
+        key = id(func)
+        with self._lock:
+            hit = self._func_cache.get(key)
+            if hit is not None and hit[0] is func:
+                return hit[1], hit[2]
+        captured: Dict[str, ObjectRef] = {}
+        with capture_refs(lambda r: captured.setdefault(r.id, r)):
+            data = serialization.dumps(func)
+        refs = tuple(captured.values())
+        with self._lock:
+            self._func_cache[key] = (func, data, refs)
+            while len(self._func_cache) > self._FUNC_CACHE_MAX:
+                self._func_cache.popitem(last=False)
+        return data, refs
+
     def _make_meta(self, spec: TaskSpec) -> dict:
         # Refs nested inside argument values are discovered during pickling
         # (ObjectRef construction hook fires for each __reduce__ round-trip
@@ -544,35 +594,33 @@ class ClusterClient:
             if ref.id not in top_level:
                 nested[ref.id] = ref
 
+        func_b, func_refs = self._pickle_func(spec.func)
+        for ref in func_refs:
+            _saw(ref)  # closure-captured refs stay deps on EVERY submit
         with capture_refs(_saw):
             spec_bytes = serialization.dumps({
-                "func": spec.func,
+                "func_b": func_b,
                 "args": spec.args,
                 "kwargs": spec.kwargs,
                 "method_name": spec.method_name,
             })
         deps = []
-        with self._lock:
-            inflight = set(self._inflight_outputs)
+        # own_inflight vouchers are NOT stamped here: _refresh_inflight_deps
+        # is the single source, run at every GCS submission (actor-call
+        # metas never hit the gate, so they don't need vouchers at all)
         for a in list(spec.args) + list(spec.kwargs.values()):
             if isinstance(a, ObjectRef):
-                d = {
+                deps.append({
                     "id": a.id,
                     # producing task, for owner-side lineage reconstruction
                     "task": a.task_id or self._ref_index.get(a.id),
-                }
-                if a.id in inflight:
-                    d["own_inflight"] = True
-                deps.append(d)
+                })
         for ref in nested.values():
-            d = {
+            deps.append({
                 "id": ref.id,
                 "task": ref.task_id or self._ref_index.get(ref.id),
                 "nested": True,
-            }
-            if ref.id in inflight:
-                d["own_inflight"] = True
-            deps.append(d)
+            })
         return {
             "task_id": spec.task_id,
             "name": spec.name,
@@ -831,13 +879,9 @@ class ClusterClient:
             self.store.put(r, err, is_exception=True)
         # publish the error as the objects themselves so tasks waiting on
         # these outputs fail with it instead of hanging at the dependency
-        # gate (reference: the owner stores the error object). On a side
-        # thread: this is reached from rpc reader/callback threads, and
-        # _publish_error retries with backoff
-        threading.Thread(
-            target=self._publish_error, args=(refs, err),
-            daemon=True, name="task-err-publish",
-        ).start()
+        # gate (reference: the owner stores the error object); enqueues to
+        # the shared publisher thread, so safe from reader threads
+        self._publish_error(refs, err)
         self._release_task_deps(task_id)
 
     def _repair_and_resubmit(self, meta: dict, lost_deps: List[dict]) -> None:
@@ -909,48 +953,80 @@ class ClusterClient:
             self._fail_task_refs(meta["task_id"], meta, f"lineage repair: {e!r}")
 
     def _publish_error(self, refs: List[ObjectRef], err: BaseException) -> None:
-        """Write an exception payload into the cluster store under each
-        ref's id, so dependents waiting on them unblock and raise.
-
-        Retries across re-picked nodes: consumers parked at the GCS gate on
-        an own_inflight voucher have ONLY this publication to wake them, so
-        best-effort isn't good enough. (Residual risk if no node accepts
-        within the window: those consumers stay parked until the next
-        node-death sweep re-evaluates them.)"""
+        """Queue an exception payload for publication into the cluster
+        store under each ref's id, so dependents waiting on them unblock
+        and raise. Non-blocking (safe from rpc reader/callback threads):
+        ONE publisher thread drains the queue, retrying across re-picked
+        nodes — consumers parked at the GCS gate on an own_inflight voucher
+        have ONLY this publication to wake them, so best-effort isn't good
+        enough, but a mass failure must also not spawn a thread per task.
+        (Residual risk if no node accepts within an entry's window: those
+        consumers stay parked until a node-death sweep sees the voucher's
+        lease expire.)"""
         payload = serialization.pack({"e": True, "v": err})
-        pending = list(refs)
-        deadline = time.time() + 15.0
-        while pending and time.time() < deadline:
-            node = self._pick_put_node()
-            if node is None:
-                time.sleep(0.5)
+        with self._err_pub_cv:
+            self._err_pub_q.append(
+                (list(refs), payload, time.time() + 15.0)
+            )
+            if self._err_pub_thread is None or not self._err_pub_thread.is_alive():
+                self._err_pub_thread = threading.Thread(
+                    target=self._err_pub_loop, daemon=True,
+                    name="err-publish",
+                )
+                self._err_pub_thread.start()
+            self._err_pub_cv.notify()
+
+    def _err_pub_loop(self) -> None:
+        while not self._closed:
+            with self._err_pub_cv:
+                while not self._err_pub_q and not self._closed:
+                    self._err_pub_cv.wait(timeout=5.0)
+                batch, self._err_pub_q = self._err_pub_q, []
+            if not batch:
                 continue
-            try:
-                daemon = self._daemon(node["node_id"], node["addr"], node["port"])
-                for r in list(pending):
-                    daemon.call(
-                        "put_object", {"object_id": r.id, "payload": payload}
+            node = self._pick_put_node()
+            daemon = None
+            if node is not None:
+                try:
+                    daemon = self._daemon(
+                        node["node_id"], node["addr"], node["port"]
                     )
-                    pending.remove(r)
-            except Exception:  # noqa: BLE001 - node bounced: re-pick
+                except Exception:  # noqa: BLE001
+                    daemon = None
+            retry = []
+            for refs, payload, deadline in batch:
+                pending = []
+                for r in refs:
+                    try:
+                        if daemon is None:
+                            raise ConnectionLost("no put node")
+                        daemon.call(
+                            "put_object",
+                            {"object_id": r.id, "payload": payload},
+                        )
+                    except Exception:  # noqa: BLE001
+                        pending.append(r)
+                        daemon = None  # node bounced: re-pick next pass
+                if pending and time.time() < deadline:
+                    retry.append((pending, payload, deadline))
+            if retry:
                 time.sleep(0.5)
+                with self._err_pub_cv:
+                    self._err_pub_q = retry + self._err_pub_q
 
     def _finalize_actor_call(self, refs: List[ObjectRef],
                              err: Optional[BaseException] = None) -> None:
         """Close out an actor call's output refs: drop them from the
         in-flight set (the GCS dep-gate flag source), and on failure
         publish the error AS the objects so cluster-side consumers parked
-        on them wake up and raise instead of waiting forever. Publication
-        runs on its own thread — this is called from rpc reader/callback
-        threads, where blocking daemon calls are forbidden."""
+        on them wake up and raise instead of waiting forever (the publish
+        enqueues to the shared publisher thread — safe from the rpc
+        reader/callback threads this runs on)."""
         with self._lock:
             for r in refs:
                 self._inflight_outputs.discard(r.id)
         if err is not None:
-            threading.Thread(
-                target=self._publish_error, args=(list(refs), err),
-                daemon=True, name="actor-err-publish",
-            ).start()
+            self._publish_error(list(refs), err)
 
     def _ingest_result(self, p: dict, refs: List[ObjectRef]):
         """Record a call's results locally; returns the error stored for

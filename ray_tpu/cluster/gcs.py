@@ -313,7 +313,7 @@ class GcsServer:
                 d for d in (p.get("deps") or ())
                 if d["id"] in missing
                 and self.active_outputs.get(d["id"], 0) == 0
-                and not d.get("own_inflight")
+                and not self._voucher_live(d)
             ]
             if dead:
                 # no copy anywhere and nothing queued will produce it: hand
@@ -388,6 +388,18 @@ class GcsServer:
                     del self.active_outputs[oid]
                 else:
                     self.active_outputs[oid] = n - 1
+
+    def _voucher_live(self, d: dict) -> bool:
+        """Is this dep's own_inflight voucher (owner's promise that its
+        in-flight actor call will produce the object) still within its
+        lease? Value is the owner's submission timestamp; True (legacy
+        bool) is honored as fresh."""
+        v = d.get("own_inflight")
+        if not v:
+            return False
+        if v is True:
+            return True
+        return (time.time() - float(v)) < self.config.own_inflight_lease_s
 
     def _missing_deps(self, t: dict) -> List[str]:
         """Dep object ids with no live location yet. Caller holds _lock."""
@@ -1077,7 +1089,7 @@ class GcsServer:
                     d for d in (t.get("deps") or ())
                     if d["id"] in missing
                     and self.active_outputs.get(d["id"], 0) == 0
-                    and not d.get("own_inflight")  # see rpc_submit_task
+                    and not self._voucher_live(d)  # see rpc_submit_task
                 ]
                 if dead_deps:
                     self._track_exit(t)
@@ -1480,9 +1492,12 @@ class GcsServer:
                     d for d in (meta.get("deps") or ())
                     if self.active_outputs.get(d["id"], 0) == 0
                     and d["id"] not in will_return
-                    and not d.get("own_inflight")  # producer is a live
-                    # actor call the GCS can't see; its owner publishes an
-                    # error object on failure, so waiters can't hang
+                    # own_inflight: producer is a live actor call the GCS
+                    # can't see; its owner publishes an error object on
+                    # failure. Honored as a LEASE — an owner that dies (or
+                    # never manages to publish) must not park the consumer
+                    # forever
+                    and not self._voucher_live(d)
                     and not any(
                         self.nodes.get(nid, {}).get("alive")
                         for nid in self.directory.get(d["id"], ())
@@ -1531,7 +1546,7 @@ class GcsServer:
                     d for d in (w["meta"].get("deps") or ())
                     if self.active_outputs.get(d["id"], 0) == 0
                     and d["id"] not in will_return
-                    and not d.get("own_inflight")  # see _dead_deps_of
+                    and not self._voucher_live(d)  # see _dead_deps_of
                     and not any(
                         self.nodes.get(nid, {}).get("alive")
                         for nid in self.directory.get(d["id"], ())

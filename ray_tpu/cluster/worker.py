@@ -175,6 +175,13 @@ def _execute(client: RpcClient, t: dict):
 
         with capture_refs(_saw_ref):
             spec = serialization.loads(t["spec_bytes"])
+            if spec.get("func_b") is not None:
+                # function shipped as separately-cached bytes (the driver
+                # pickles each function once, not per task); loaded inside
+                # capture_refs so closure-captured refs are seen too
+                spec["func"] = serialization.loads(spec["func_b"])
+            else:
+                spec.setdefault("func", None)
             is_actor_task = bool(t.get("actor_creation") or t.get("actor_id"))
             arg_pins = None if is_actor_task else pins
             args = tuple(_resolve(client, a, arg_pins) for a in spec["args"])

@@ -23,6 +23,10 @@ _DEFS: Dict[str, tuple] = {
     # on the bit-identical NumPy twin (device dispatch latency dominates
     # small solves); 0 = always use the device
     "jax_policy_min_cells": (int, 262_144),
+    # how long the dep gate honors an owner's "my in-flight actor call will
+    # produce this object" voucher before node-death sweeps may re-evaluate
+    # the dep (guards against owners that die/fail to publish an error)
+    "own_inflight_lease_s": (float, 600.0),
     "scheduler_round_interval_ms": (float, 2.0),
     "max_direct_call_object_size": (int, 100 * 1024),  # inline-in-reply threshold
     "worker_lease_timeout_ms": (float, 500.0),
