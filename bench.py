@@ -262,9 +262,52 @@ def config_5(dev):
         if rnd > 200:
             break
     t_sched = float(np.sum(sched_times))
+    # on-DEVICE round time, separated from the host link: round_ms_median
+    # includes the decision download (narrow-dtype, but the axon tunnel has
+    # been measured as low as ~35 MB/s), which direct-attached TPU hardware
+    # does over PCIe in ~1ms. The north-star "<50ms/round" clause is about
+    # the scheduling round itself, so report both.
+    import jax.numpy as jnp
+
+    from ray_tpu.sched import kernel_jax as K
+
+    pad = K.bucket_size(demands.shape[0])
+    d, k = K.pad_problem(
+        np.asarray(demands, np.float32),
+        np.maximum(counts // chunks, 1).astype(np.int32), pad,
+    )
+    dj = jax.device_put(jnp.asarray(d), dev)
+    active = tuple(int(i) for i in np.flatnonzero((d > 0).any(axis=0)))
+
+    def run_kernel(kk):
+        # mirror JaxScheduler.schedule's ALGO dispatch so the device number
+        # is attributed to the same kernel round_ms_median measured
+        if ALGO == "rounds":
+            return K.schedule_classes_rounds(
+                sched.avail, sched.total, sched.alive, dj, kk,
+                active_idx=active,
+            )
+        if ALGO == "chunked":
+            return K.schedule_classes_chunked(
+                sched.avail, sched.total, sched.alive, dj, kk,
+                active_idx=active,
+            )
+        return K.schedule_classes(sched.avail, sched.total, sched.alive, dj, kk)
+
+    dev_times = []
+    for i in range(4):
+        kk = jax.device_put(
+            jnp.asarray(np.maximum(k + (i - 1), 0).astype(np.int32)), dev
+        )
+        t0 = time.perf_counter()
+        a, na = run_kernel(kk)
+        a.block_until_ready()
+        na.block_until_ready()
+        dev_times.append(time.perf_counter() - t0)
     return {
         "rounds": len(sched_times),
         "round_ms_median": round(float(np.median(sched_times)) * 1e3, 1),
+        "round_ms_device": round(float(np.median(dev_times[1:])) * 1e3, 1),
         "decisions": total_decisions,
         "decisions_per_sec": round(total_decisions / t_sched, 1),
         "autoscaled_at_round": scaled_up_at,

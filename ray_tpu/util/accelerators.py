@@ -1,0 +1,95 @@
+"""Accelerator-type constants and helpers (TPU-first).
+
+Reference: python/ray/util/accelerators/ — string constants tasks pass as
+`accelerator_type=` plus TPU pod helpers (`ray.util.accelerators.tpu`
+get_current_pod_name / get_current_pod_worker_count). Here the constants
+are TPU generations (the GPU zoo is out of scope for a TPU-native
+framework; CPU fallback needs no type), the current-device probe reads
+jax's device_kind, and pod topology comes from the standard TPU runtime
+env vars.
+
+Scheduling integration: `accelerator_resource(t)` converts a type
+constant into the custom-resource dict understood by
+`@ray_tpu.remote(resources=...)` — nodes advertise the matching resource
+(e.g. {"TPU-v5e": 4}) and the scheduler's masked feasibility does the
+rest; no special-cased accelerator pathway exists or is needed.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+# type constants (values match device_kind prefixes jax reports)
+TPU_V2 = "TPU-v2"
+TPU_V3 = "TPU-v3"
+TPU_V4 = "TPU-v4"
+TPU_V5E = "TPU-v5e"
+TPU_V5P = "TPU-v5p"
+TPU_V6E = "TPU-v6e"
+
+_KIND_MAP = {
+    "tpu v2": TPU_V2,
+    "tpu v3": TPU_V3,
+    "tpu v4": TPU_V4,
+    "tpu v5 lite": TPU_V5E,
+    "tpu v5e": TPU_V5E,
+    "tpu v5": TPU_V5P,
+    "tpu v6 lite": TPU_V6E,
+    "tpu v6e": TPU_V6E,
+}
+
+
+def current_accelerator_type() -> Optional[str]:
+    """Type constant for this process's first accelerator, or None on a
+    CPU-only host. Lazy: importing this module never touches jax."""
+    try:
+        import jax
+
+        devices = jax.devices()
+    except Exception:  # noqa: BLE001 - no backend / init failure
+        return None
+    if not devices:
+        return None
+    kind = getattr(devices[0], "device_kind", "").lower()
+    for prefix in sorted(_KIND_MAP, key=len, reverse=True):
+        if kind.startswith(prefix):
+            return _KIND_MAP[prefix]
+    if kind and "tpu" in kind:
+        return kind  # unknown generation: pass the raw kind through
+    return None
+
+
+def accelerator_resource(accelerator_type: str, n: float = 1.0) -> Dict[str, float]:
+    """Resource dict for @remote(resources=...) demanding `n` chips of a
+    type; nodes advertise the same key via --resources."""
+    return {accelerator_type: float(n)}
+
+
+# ---------------------------------------------------------------- tpu pods
+
+
+def get_current_pod_name() -> Optional[str]:
+    """The TPU pod-slice name this worker belongs to (reference:
+    ray.util.accelerators.tpu.get_current_pod_name; from the TPU runtime's
+    env)."""
+    return (
+        os.environ.get("TPU_NAME")
+        or os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",")[0]
+        or None
+    )
+
+
+def get_current_pod_worker_count() -> Optional[int]:
+    """How many hosts form this pod slice, or None outside a pod."""
+    hosts = os.environ.get("TPU_WORKER_HOSTNAMES")
+    if hosts:
+        return len([h for h in hosts.split(",") if h])
+    n = os.environ.get("TPU_NUM_WORKERS")
+    return int(n) if n else None
+
+
+def get_current_pod_worker_id() -> Optional[int]:
+    """This host's index within its pod slice, or None outside a pod."""
+    wid = os.environ.get("TPU_WORKER_ID")
+    return int(wid) if wid is not None and wid != "" else None

@@ -165,3 +165,30 @@ def test_killed_named_actor_unregistered(ray8):
     ray_tpu.kill(h)
     with pytest.raises(ValueError):
         ray_tpu.get_actor("doomed")
+
+
+def test_accelerators_helpers(monkeypatch):
+    """ray.util.accelerators parity: type constants, resource mapping, pod
+    env helpers (reference: python/ray/util/accelerators/)."""
+    from ray_tpu.util import accelerators as acc
+
+    assert acc.accelerator_resource(acc.TPU_V5E, 4) == {"TPU-v5e": 4.0}
+
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "h0,h1,h2,h3")
+    monkeypatch.setenv("TPU_WORKER_ID", "2")
+    monkeypatch.setenv("TPU_NAME", "slice-a")
+    assert acc.get_current_pod_name() == "slice-a"
+    assert acc.get_current_pod_worker_count() == 4
+    assert acc.get_current_pod_worker_id() == 2
+
+    monkeypatch.delenv("TPU_WORKER_HOSTNAMES")
+    monkeypatch.delenv("TPU_NAME")
+    monkeypatch.delenv("TPU_WORKER_ID")
+    monkeypatch.setenv("TPU_NUM_WORKERS", "8")
+    assert acc.get_current_pod_name() is None
+    assert acc.get_current_pod_worker_count() == 8
+    assert acc.get_current_pod_worker_id() is None
+
+    # CPU test env: current type resolves to None or a TPU kind string
+    t = acc.current_accelerator_type()
+    assert t is None or isinstance(t, str)
