@@ -1,10 +1,12 @@
 from ray_tpu.parallel.mesh import make_mesh, mesh_shape_for
 from ray_tpu.parallel.pipeline import pipeline_apply
 from ray_tpu.parallel.ring_attention import ring_attention
+from ray_tpu.parallel.ulysses import ulysses_attention
 
 __all__ = [
     "make_mesh",
     "mesh_shape_for",
     "pipeline_apply",
     "ring_attention",
+    "ulysses_attention",
 ]

@@ -1,0 +1,86 @@
+"""Ulysses sequence parallelism: all-to-all head-scatter attention.
+
+The second SP strategy the inventory names (SURVEY §2.5: "SP/CP,
+ring attention, Ulysses" — the reference has neither in-tree). Where ring
+attention keeps heads whole and rotates KV blocks around the ICI ring
+(`ring_attention.py`), Ulysses re-shards at the attention boundary: the
+sequence-sharded activations are `all_to_all`-ed so each device holds the
+FULL sequence for a SLICE of heads, runs ordinary (full) attention on
+those heads locally, and `all_to_all`s back to sequence sharding.
+
+Trade-offs vs ring (DeepSpeed-Ulysses literature; implementation
+original):
+  - communication is two all-to-alls of the whole activation set,
+    independent of step count — cheaper than the ring's p ppermute hops
+    for moderate S, and every matmul stays a single large MXU-friendly
+    block (no online-softmax accumulation);
+  - HBM must hold the FULL [S, H/p] K and V, so maximum context is
+    bounded by memory/p (the ring holds only one KV block at a time);
+  - the axis size must divide the HEAD count (ring only needs it to
+    divide S).
+Pick ring for extreme context lengths, Ulysses when heads >= devices and
+S fits: both present the same [B, S(sharded), H, Dh] layout contract.
+
+Layout: q, k, v are [B, S, H, Dh] with S sharded over the mesh axis.
+Inside shard_map each device sees [B, S/p, H, Dh]; `lax.all_to_all` with
+tiled=True scatters the head dim and concatenates the sequence dim.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ray_tpu.parallel.ring_attention import reference_attention
+
+
+def _ulysses_local(q, k, v, *, axis_name: str, causal: bool):
+    """Per-device body under shard_map. q,k,v: local [B, S/p, H, Dh]."""
+    # scatter heads (axis 2), gather sequence (axis 1): -> [B, S, H/p, Dh]
+    q_h, k_h, v_h = (
+        jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+        for x in (q, k, v)
+    )
+    # after the head-scatter each device holds the FULL sequence for its
+    # head slice, so the local computation IS plain full attention — share
+    # the math with the ring module's reference (drift between the two SP
+    # strategies is exactly what test_ulysses_matches_ring guards)
+    o = reference_attention(q_h, k_h, v_h, causal=causal)
+    # gather heads back, re-scatter sequence: -> [B, S/p, H, Dh]
+    return jax.lax.all_to_all(
+        o, axis_name, split_axis=1, concat_axis=2, tiled=True
+    )
+
+
+def ulysses_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    *,
+    axis_name: str = "sp",
+    causal: bool = True,
+) -> jnp.ndarray:
+    """Sequence-parallel attention via head-scatter all-to-all.
+
+    q, k, v: [B, S, H, Dh]; S must be divisible by the axis size and H must
+    be divisible by the axis size (each device owns H/p full-sequence
+    heads). Returns the same layout/sharding as the inputs. Jit-safe; the
+    all-to-alls ride ICI.
+    """
+    p = mesh.shape[axis_name]
+    if q.shape[2] % p:
+        raise ValueError(
+            f"ulysses needs heads ({q.shape[2]}) divisible by mesh axis "
+            f"{axis_name!r} ({p}); use ring_attention otherwise"
+        )
+    spec = P(None, axis_name, None, None)
+    fn = functools.partial(_ulysses_local, axis_name=axis_name, causal=causal)
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )(q, k, v)
+
+
+__all__ = ["ulysses_attention", "reference_attention"]

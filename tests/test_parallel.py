@@ -17,6 +17,7 @@ from ray_tpu.parallel.ring_attention import (
     reference_attention,
     ring_attention,
 )
+from ray_tpu.parallel.ulysses import ulysses_attention
 from ray_tpu.models.moe import (
     MoEConfig,
     init_moe_params,
@@ -46,6 +47,37 @@ def test_ring_attention_matches_dense(causal, sp):
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5
     )
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("sp", [2, 4])
+def test_ulysses_attention_matches_dense(causal, sp):
+    mesh = make_mesh(("sp",), shape=(sp,), devices=jax.devices()[:sp])
+    q, k, v = _qkv(jax.random.PRNGKey(2))  # H=4 divisible by sp
+    out = jax.jit(
+        lambda q, k, v: ulysses_attention(q, k, v, mesh, causal=causal)
+    )(q, k, v)
+    ref = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_ulysses_matches_ring():
+    """The two SP strategies present the same contract: same inputs, same
+    sharding, numerically equal outputs."""
+    mesh = make_mesh(("sp",), shape=(4,), devices=jax.devices()[:4])
+    q, k, v = _qkv(jax.random.PRNGKey(3), S=64)
+    a = jax.jit(lambda q, k, v: ulysses_attention(q, k, v, mesh))(q, k, v)
+    b = jax.jit(lambda q, k, v: ring_attention(q, k, v, mesh))(q, k, v)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5)
+
+
+def test_ulysses_rejects_indivisible_heads():
+    mesh = make_mesh(("sp",), shape=(8,))
+    q, k, v = _qkv(jax.random.PRNGKey(4))  # H=4 < 8
+    with pytest.raises(ValueError, match="divisible"):
+        ulysses_attention(q, k, v, mesh)
 
 
 def test_ring_attention_composes_with_dp():
