@@ -208,6 +208,58 @@ def cmd_dashboard(args):
         head.shutdown()
 
 
+def cmd_job(args):
+    """Job CLI over the dashboard head's REST API (reference:
+    python/ray/dashboard/modules/job/cli.py — also a thin HTTP client)."""
+    import shlex
+    import urllib.error
+    import urllib.request
+
+    base = f"http://{args.dashboard}"
+
+    def req(path, payload=None):
+        data = json.dumps(payload).encode() if payload is not None else None
+        r = urllib.request.Request(
+            base + path, data=data,
+            headers={"Content-Type": "application/json"},
+            method="POST" if data is not None else "GET",
+        )
+        try:
+            with urllib.request.urlopen(r, timeout=30) as resp:
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            # the head returns JSON error bodies with 4xx — show them
+            try:
+                body = json.loads(e.read())
+            except Exception:  # noqa: BLE001
+                body = {"error": str(e)}
+            sys.exit(f"error: {body.get('error', body)}")
+        except urllib.error.URLError as e:
+            sys.exit(f"dashboard not reachable at {base}: {e.reason}")
+
+    if args.job_command == "submit":
+        ep = args.entrypoint
+        if ep and ep[0] == "--":  # argparse REMAINDER keeps the separator
+            ep = ep[1:]
+        # shlex.join: the head re-parses this with shell=True, so each argv
+        # element must survive re-quoting (spaces, -c scripts, metachars)
+        body = {"entrypoint": shlex.join(ep)}
+        if args.submission_id:
+            body["submission_id"] = args.submission_id
+        out = req("/api/jobs", body)
+    elif args.job_command == "list":
+        out = req("/api/jobs")
+    elif args.job_command == "status":
+        out = req(f"/api/jobs/{args.job_id}")
+    elif args.job_command == "logs":
+        out = req(f"/api/jobs/{args.job_id}/logs")
+        print(out.get("logs", ""))
+        return
+    else:  # stop
+        out = req(f"/api/jobs/{args.job_id}/stop", {})
+    print(json.dumps(out, indent=2))
+
+
 def cmd_up(args):
     from ray_tpu.autoscaler.launcher import cluster_up
     from ray_tpu.util.usage import record_event
@@ -270,6 +322,19 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--address")
     sp.add_argument("--port", type=int, default=8265)
     sp.set_defaults(fn=cmd_dashboard)
+
+    sp = sub.add_parser("job", help="submit/inspect jobs via the dashboard")
+    jsub = sp.add_subparsers(dest="job_command", required=True)
+    js = jsub.add_parser("submit", help="run an entrypoint as a job")
+    js.add_argument("--dashboard", default="127.0.0.1:8265")
+    js.add_argument("--submission-id", default=None)
+    js.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    for name in ("list", "status", "logs", "stop"):
+        jp = jsub.add_parser(name)
+        jp.add_argument("--dashboard", default="127.0.0.1:8265")
+        if name != "list":
+            jp.add_argument("job_id")
+    sp.set_defaults(fn=cmd_job)
 
     sp = sub.add_parser("up", help="launch a cluster from a YAML config")
     sp.add_argument("config", help="cluster YAML path")

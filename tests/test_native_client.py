@@ -7,6 +7,7 @@ cross-language named-call path, and REST job submission
 
 import ctypes
 import json
+import os
 import sys
 import time
 
@@ -142,3 +143,63 @@ def test_job_stop(dash, lib):
             break
         time.sleep(0.3)
     assert st["status"] in ("STOPPED", "FAILED")
+
+
+def test_job_cli_roundtrip(dash):
+    """`ray_tpu job submit/status/logs/list/stop` against the live head
+    (reference: dashboard job CLI is a thin HTTP client too)."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        + os.pathsep + env.get("PYTHONPATH", "")
+    )
+    run = lambda *args: subprocess.run(
+        [sys.executable, "-m", "ray_tpu", "job",
+         args[0], "--dashboard", f"127.0.0.1:{dash.port}", *args[1:]],
+        capture_output=True, text=True, timeout=120, env=env,
+    )
+    out = run("submit", "--submission-id", "cli-job-1", "--",
+              "echo", "cli-job-output")
+    assert '"job_id": "cli-job-1"' in out.stdout, out.stdout + out.stderr
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        st = run("status", "cli-job-1")
+        if '"SUCCEEDED"' in st.stdout:
+            break
+        time.sleep(0.5)
+    assert '"SUCCEEDED"' in st.stdout, st.stdout + st.stderr
+    logs = run("logs", "cli-job-1")
+    assert "cli-job-output" in logs.stdout
+    lst = run("list")
+    assert "cli-job-1" in lst.stdout
+    # quoting survives the shell round-trip (shlex.join on the client,
+    # shell=True on the head)
+    out = run("submit", "--submission-id", "cli-job-q", "--",
+              sys.executable, "-c", "print('quo ted')")
+    assert '"cli-job-q"' in out.stdout, out.stdout + out.stderr
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        st = run("status", "cli-job-q")
+        if '"SUCCEEDED"' in st.stdout:
+            break
+        time.sleep(0.5)
+    assert '"SUCCEEDED"' in st.stdout, st.stdout + st.stderr
+    assert "quo ted" in run("logs", "cli-job-q").stdout
+    # stop a long-running job through the CLI
+    out = run("submit", "--submission-id", "cli-job-s", "--",
+              sys.executable, "-c", "import time; time.sleep(300)")
+    assert '"cli-job-s"' in out.stdout
+    run("stop", "cli-job-s")
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        st = run("status", "cli-job-s")
+        if '"RUNNING"' not in st.stdout:
+            break
+        time.sleep(0.3)
+    assert '"STOPPED"' in st.stdout or '"FAILED"' in st.stdout, st.stdout
+    # server-side errors surface as clean messages, not tracebacks
+    err = run("status", "no-such-job")
+    assert err.returncode != 0 and "no job" in (err.stdout + err.stderr)
+    assert "Traceback" not in err.stderr
