@@ -205,6 +205,10 @@ class GcsServer:
     # --- node lifecycle (reference: gcs_node_manager.cc) ---
 
     def rpc_register_node(self, p, conn):
+        from ray_tpu.util.events import record_event
+
+        record_event("NODE_ADDED", f"node {p['node_id']} registered",
+                     source="gcs", node_id=p["node_id"])
         with self._lock:
             node_id = p["node_id"]
             self.nodes[node_id] = {
@@ -570,6 +574,21 @@ class GcsServer:
             self._kick()
         return {"ok": True}
 
+    def rpc_list_events(self, p, conn):
+        """Structured-event ring (reference: dashboard event aggregation
+        over RAY_EVENT records). Events are telemetry local to this GCS
+        process; remote viewers (dashboard head, state CLI) pull them
+        here."""
+        from ray_tpu.util.events import list_events
+
+        return {
+            "events": list_events(
+                limit=int(p.get("limit", 1000)),
+                severity=p.get("severity"),
+                label=p.get("label"),
+            )
+        }
+
     def rpc_locate_object(self, p, conn):
         with self._lock:
             nodes = [
@@ -732,6 +751,12 @@ class GcsServer:
             a["state"] = "DEAD"
             a["death_cause"] = cause
             return False
+        from ray_tpu.util.events import record_event
+
+        record_event("ACTOR_RESTARTING",
+                     f"actor {aid} restarting ({cause})",
+                     severity="WARNING", source="gcs",
+                     actor_id=aid, restarts=a.get("restarts", 0) + 1)
         a["restarts"] = a.get("restarts", 0) + 1
         a["state"] = "RESTARTING"
         a["node_id"] = None
@@ -969,6 +994,13 @@ class GcsServer:
                 # changed the state owned the resource bookkeeping
                 return False
             if ok:
+                from ray_tpu.util.events import record_event
+
+                record_event(
+                    "PLACEMENT_GROUP_CREATED",
+                    f"pg {pg_id} committed on {len(set(node_ids))} nodes",
+                    source="gcs", pg_id=pg_id,
+                )
                 pg["state"] = "CREATED"
                 pg["epoch"] = pg.get("epoch", 0) + 1
                 # per-bundle capacity accounting: tasks riding a bundle debit
@@ -1455,10 +1487,15 @@ class GcsServer:
     def _mark_node_dead(self, node_id: str, cause: str):
         """Reference: GcsNodeManager::OnNodeFailure — broadcast death, fail
         running tasks (owners retry / reconstruct), restart actors."""
+        from ray_tpu.util.events import record_event
+
         with self._lock:
             n = self.nodes.get(node_id)
             if not n or not n["alive"]:
-                return
+                return  # already dead: later causes must not re-emit events
+            record_event("NODE_DIED", f"node {node_id} died: {cause}",
+                         severity="WARNING", source="gcs",
+                         node_id=node_id, cause=cause)
             n["alive"] = False
             self.state.remove_node(node_id)
             lost_tasks = [

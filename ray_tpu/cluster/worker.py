@@ -154,6 +154,36 @@ def _resolve_runtime_env(rtenv):
     return rtenv.get("env_vars"), cwd
 
 
+# deserialized-function cache (driver side pickles each function once; the
+# worker shouldn't re-unpickle it per task either). Functions whose bytes
+# deserialize ObjectRefs (closure-captured refs) are NOT cached: each
+# execution must re-materialize them under capture_refs so borrow tracking
+# keeps seeing them. Keyed by the pickle bytes; bounded FIFO.
+_func_cache: dict = {}
+_FUNC_CACHE_MAX = 256
+
+
+def _load_func(func_b: bytes, saw_ref) -> object:
+    hit = _func_cache.get(func_b)
+    if hit is not None:
+        return hit
+    refs_seen: list = []
+
+    def probe(r):
+        refs_seen.append(r)
+        saw_ref(r)
+
+    from ray_tpu.core.object_ref import capture_refs as _cap
+
+    with _cap(probe):
+        fn = serialization.loads(func_b)
+    if not refs_seen:
+        if len(_func_cache) >= _FUNC_CACHE_MAX:
+            _func_cache.pop(next(iter(_func_cache)))
+        _func_cache[func_b] = fn
+    return fn
+
+
 def _execute(client: RpcClient, t: dict):
     task_id = t["task_id"]
     start = time.time()
@@ -179,7 +209,7 @@ def _execute(client: RpcClient, t: dict):
                 # function shipped as separately-cached bytes (the driver
                 # pickles each function once, not per task); loaded inside
                 # capture_refs so closure-captured refs are seen too
-                spec["func"] = serialization.loads(spec["func_b"])
+                spec["func"] = _load_func(spec["func_b"], _saw_ref)
             else:
                 spec.setdefault("func", None)
             is_actor_task = bool(t.get("actor_creation") or t.get("actor_id"))

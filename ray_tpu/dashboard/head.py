@@ -57,6 +57,7 @@ class DashboardHead:
       /api/placement_groups     PG table                     (GET)
       /api/cluster_resources    total resources              (GET)
       /api/available_resources  free resources               (GET)
+      /api/events               structured cluster events    (GET)
       /api/jobs                 list jobs / submit entrypoint (GET/POST)
       /api/jobs/<id>[/logs]     job status / captured logs   (GET)
       /api/jobs/<id>/stop       terminate a running job      (POST)
@@ -139,6 +140,7 @@ class DashboardHead:
                     "/api/summary", "/api/nodes", "/api/actors",
                     "/api/tasks?limit=N", "/api/placement_groups",
                     "/api/cluster_resources", "/api/available_resources",
+                    "/api/events?limit=N&severity=&label=",
                     "/api/jobs [GET|POST]", "/api/jobs/<id>",
                     "/api/jobs/<id>/logs", "/api/jobs/<id>/stop [POST]",
                     "/api/call [POST]",
@@ -158,6 +160,35 @@ class DashboardHead:
             return c.cluster_resources(), 200
         if route == "/api/available_resources":
             return c.available_resources(), 200
+        if route == "/api/events":
+            from ray_tpu.util.events import list_events
+
+            limit = int(params.get("limit", 1000))
+            try:
+                remote = self._client.gcs.call(
+                    "list_events",
+                    {"limit": limit, "severity": params.get("severity"),
+                     "label": params.get("label")},
+                )["events"]
+            except Exception:  # noqa: BLE001 - GCS bounced mid-request
+                remote = []
+            # merge the GCS's ring with this process's own (job events);
+            # dedupe — when the head shares the GCS's process (local mode,
+            # tests) both reads hit the SAME module-global ring
+            local = list_events(limit=limit, severity=params.get("severity"),
+                                label=params.get("label"))
+            seen = set()
+            merged = []
+            for e in sorted(
+                remote + local, key=lambda e: e["timestamp"], reverse=True
+            ):
+                key = (e.get("timestamp"), e.get("pid"), e.get("label"),
+                       e.get("message"))
+                if key in seen:
+                    continue
+                seen.add(key)
+                merged.append(e)
+            return merged[:limit], 200
         if route == "/api/jobs":
             with self._jobs_lock:
                 jobs = [j for j in self._jobs.values() if j is not None]
@@ -256,6 +287,10 @@ class DashboardHead:
         }
         with self._jobs_lock:
             self._jobs[jid] = job
+        from ray_tpu.util.events import record_event
+
+        record_event("JOB_SUBMITTED", f"job {jid}: {entry[:120]}",
+                     source="dashboard", job_id=jid)
         return self._job_view(job), 200
 
     @staticmethod

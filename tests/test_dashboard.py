@@ -70,3 +70,26 @@ def test_dashboard_unknown_route(dash):
     with pytest.raises(urllib.error.HTTPError) as ei:
         _get(dash.url + "/api/nope")
     assert ei.value.code == 404
+
+
+def test_events_endpoint(dash):
+    """Structured events: GCS lifecycle records merged with head-local job
+    events at /api/events (reference: RAY_EVENT -> dashboard events)."""
+    body, st = _get(dash.url + "/api/events?limit=50")
+    assert st == 200
+    labels = {e["label"] for e in body}
+    assert "NODE_ADDED" in labels, labels
+    for e in body:
+        assert {"timestamp", "severity", "label", "source"} <= set(e)
+    # severity filter round-trips
+    body, _ = _get(dash.url + "/api/events?severity=ERROR")
+    assert all(e["severity"] == "ERROR" for e in body)
+
+
+def test_events_not_duplicated_in_shared_process(dash):
+    """Local mode runs GCS and head in one process: both reads hit the same
+    ring and the endpoint must dedupe."""
+    body, _ = _get(dash.url + "/api/events?limit=500")
+    keys = [(e["timestamp"], e.get("pid"), e["label"], e.get("message"))
+            for e in body]
+    assert len(keys) == len(set(keys)), "duplicate events in merged view"
