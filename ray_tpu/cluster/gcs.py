@@ -207,10 +207,9 @@ class GcsServer:
     def rpc_register_node(self, p, conn):
         from ray_tpu.util.events import record_event
 
-        record_event("NODE_ADDED", f"node {p['node_id']} registered",
-                     source="gcs", node_id=p["node_id"])
         with self._lock:
             node_id = p["node_id"]
+            rejoin = node_id in self.nodes
             self.nodes[node_id] = {
                 "node_id": node_id,
                 "addr": p["addr"],
@@ -222,6 +221,12 @@ class GcsServer:
                 "labels": p.get("labels", {}),
                 "shm_name": p.get("shm_name"),
             }
+            # recorded only after the entry commits (a malformed payload
+            # must not leave an event for a node that never joined); rejoin
+            # marks a dead node's re-registration so event consumers can
+            # count distinct joins
+            record_event("NODE_ADDED", f"node {node_id} registered",
+                         source="gcs", node_id=node_id, rejoin=rejoin)
             conn.meta["node_id"] = node_id
             if self.state.node_index(node_id) is None:
                 self.state.add_node(node_id, p["resources"], p.get("labels"))

@@ -156,6 +156,7 @@ def cmd_list(args):
         "nodes": state.list_nodes,
         "objects": lambda: state.list_objects(args.limit),
         "placement-groups": state.list_placement_groups,
+        "cluster-events": lambda: state.list_cluster_events(args.limit),
     }[kind]
     rows = fn()
     print(json.dumps(rows, indent=1, default=str))
@@ -306,9 +307,9 @@ def build_parser() -> argparse.ArgumentParser:
             sp.add_argument("-o", "--output")
         sp.set_defaults(fn=fn)
 
-    sp = sub.add_parser("list", help="list tasks/actors/nodes/objects/placement-groups")
+    sp = sub.add_parser("list", help="list tasks/actors/nodes/objects/placement-groups/cluster-events")
     sp.add_argument("kind", choices=["tasks", "actors", "nodes", "objects",
-                                     "placement-groups"])
+                                     "placement-groups", "cluster-events"])
     sp.add_argument("--address")
     sp.add_argument("--limit", type=int, default=1000)
     sp.set_defaults(fn=cmd_list)

@@ -23,7 +23,9 @@ SEVERITIES = ("DEBUG", "INFO", "WARNING", "ERROR")
 _MAX_EVENTS = 10_000
 _events: "deque" = deque(maxlen=_MAX_EVENTS)
 _lock = threading.Lock()
-_sink_path: Optional[str] = None
+# JSONL sink: RAY_TPU_EVENT_LOG=<path> (reference: the event framework's
+# per-session event_*.log files), or configure_sink() programmatically
+_sink_path: Optional[str] = os.environ.get("RAY_TPU_EVENT_LOG") or None
 
 
 def configure_sink(path: Optional[str]) -> None:

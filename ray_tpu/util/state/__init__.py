@@ -7,6 +7,7 @@ src/ray/gcs/gcs_server/gcs_task_manager.cc).
 
 from ray_tpu.util.state.api import (
     list_actors,
+    list_cluster_events,
     list_nodes,
     list_objects,
     list_placement_groups,
@@ -20,6 +21,7 @@ __all__ = [
     "chrome_trace",
     "dump_timeline",
     "list_actors",
+    "list_cluster_events",
     "list_nodes",
     "list_objects",
     "list_placement_groups",

@@ -38,6 +38,27 @@ def list_placement_groups() -> List[dict]:
     return _rt().list_placement_groups()
 
 
+def list_cluster_events(
+    limit: int = 1000, severity: Optional[str] = None,
+    label: Optional[str] = None,
+) -> List[dict]:
+    """Structured cluster events (reference: `ray list cluster-events`).
+    Cluster mode pulls the GCS process's ring over rpc; local mode reads
+    the in-process ring directly."""
+    rt = _rt()
+    gcs = getattr(rt, "gcs", None)
+    if gcs is not None:
+        # no silent local fallback here: in cluster mode the local ring is
+        # empty, so masking an RPC failure would present as "no events"
+        return gcs.call(
+            "list_events",
+            {"limit": limit, "severity": severity, "label": label},
+        )["events"]
+    from ray_tpu.util.events import list_events
+
+    return list_events(limit=limit, severity=severity, label=label)
+
+
 def summary() -> dict:
     return _rt().summary()
 

@@ -117,3 +117,20 @@ def test_cli_end_to_end(tmp_path):
     finally:
         stop = run("stop")
         assert "stopped" in stop.stdout
+
+
+def test_list_cluster_events_cluster_mode():
+    from ray_tpu.cluster import Cluster
+
+    c = Cluster()
+    c.add_node(num_cpus=2)
+    c.wait_for_nodes(1)
+    ray_tpu.init(address=c.address)
+    try:
+        from ray_tpu.util import state
+
+        evs = state.list_cluster_events(limit=100)
+        assert any(e["label"] == "NODE_ADDED" for e in evs), evs[:3]
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()

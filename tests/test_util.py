@@ -192,3 +192,25 @@ def test_accelerators_helpers(monkeypatch):
     # CPU test env: current type resolves to None or a TPU kind string
     t = acc.current_accelerator_type()
     assert t is None or isinstance(t, str)
+
+
+def test_event_sink_and_clear(tmp_path):
+    """JSONL sink + ring clearing (reference: per-session event logs)."""
+    import json as _json
+
+    from ray_tpu.util import events
+
+    sink = tmp_path / "events.jsonl"
+    events.configure_sink(str(sink))
+    try:
+        events.record_event("TEST_EVENT", "hello", severity="ERROR", k=1)
+        evs = events.list_events(label="TEST_EVENT")
+        assert evs and evs[0]["severity"] == "ERROR" and evs[0]["k"] == 1
+        lines = [
+            _json.loads(line) for line in sink.read_text().splitlines()
+        ]
+        assert any(l["label"] == "TEST_EVENT" for l in lines)
+    finally:
+        events.configure_sink(None)
+        events.clear_events()
+    assert events.list_events(label="TEST_EVENT") == []
