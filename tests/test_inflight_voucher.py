@@ -1,0 +1,84 @@
+"""GCS dep-gate own_inflight voucher semantics (client side is covered by
+tests/test_fault_tolerance.py's racing-consumer tests; these drive the
+GCS classification directly)."""
+
+import time
+
+import pytest
+
+from ray_tpu.core.config import Config
+from ray_tpu.cluster.gcs import GcsServer
+from ray_tpu.cluster.testing import (
+    FakeConn,
+    park_scheduler_loop,
+    register_fake_nodes,
+)
+
+
+@pytest.fixture()
+def gcs():
+    g = GcsServer(config=Config({
+        "scheduler_round_interval_ms": 60_000.0,
+        "own_inflight_lease_s": 5.0,
+    }))
+    park_scheduler_loop(g)
+    register_fake_nodes(g, 2, lambda i: {"CPU": 4})
+    yield g
+    g.shutdown()
+
+
+def _submit(gcs, conn, tid, deps):
+    return gcs.rpc_submit_task(
+        {"task_id": tid, "class_key": 1, "resources": {"CPU": 1},
+         "num_returns": 1, "owner": "drv", "deps": deps},
+        conn,
+    )
+
+
+def test_fresh_voucher_parks_instead_of_deps_lost(gcs):
+    """A missing dep with a live voucher parks the task at the gate."""
+    conn = FakeConn()
+    r = _submit(gcs, conn, "t-fresh",
+                [{"id": "obj-pending", "own_inflight": time.time()}])
+    assert r.get("ok", True), r  # not bounced as deps_lost
+    gcs._schedule_round()
+    assert "t-fresh" in gcs.waiting_tasks
+
+
+def test_no_voucher_is_deps_lost(gcs):
+    """The same missing dep WITHOUT a voucher is declared lost at intake."""
+    conn = FakeConn()
+    r = _submit(gcs, conn, "t-naked", [{"id": "obj-nowhere"}])
+    assert r.get("deps_lost") == ["obj-nowhere"], r
+
+
+def test_expired_voucher_is_deps_lost(gcs):
+    """A voucher past own_inflight_lease_s no longer protects the dep —
+    the owner either published the object/error long ago or died."""
+    conn = FakeConn()
+    stale = time.time() - 60.0  # lease is 5s
+    r = _submit(gcs, conn, "t-stale",
+                [{"id": "obj-gone", "own_inflight": stale}])
+    assert r.get("deps_lost") == ["obj-gone"], r
+
+
+def test_voucher_retired_once_object_produced(gcs):
+    """one-shot: after the object appears, the voucher is stripped, so a
+    later loss of the object is handled as lost-for-real."""
+    conn = FakeConn()
+    _submit(gcs, conn, "t-oneshot",
+            [{"id": "obj-late", "own_inflight": time.time()}])
+    gcs._schedule_round()
+    assert "t-oneshot" in gcs.waiting_tasks
+    # the object is produced on node 0
+    node_id = next(iter(gcs.nodes))
+    gcs.rpc_add_object_location(
+        {"object_id": "obj-late", "node_id": node_id}, conn
+    )
+    # single dep -> the waiting entry is promoted straight to pending
+    assert "t-oneshot" not in gcs.waiting_tasks
+    gcs._schedule_round()
+    info = gcs.running.get("t-oneshot")
+    assert info is not None, "task did not dispatch after dep arrived"
+    deps = info["meta"].get("deps") or ()
+    assert deps and all("own_inflight" not in d for d in deps), deps
