@@ -1309,7 +1309,7 @@ class ClusterClient:
         raw = self.gcs.call("get_nodes")
         return [
             {"NodeID": nid, "Alive": n["alive"], "Resources": n["resources"],
-             "Labels": n.get("labels", {})}
+             "Labels": n.get("labels", {}), "Stats": n.get("stats") or {}}
             for nid, n in raw.items()
         ]
 

@@ -268,13 +268,18 @@ class GcsServer:
             n = self.nodes.get(p["node_id"])
             if n:
                 n["last_beat"] = time.time()
+                if p.get("stats"):
+                    # per-node physical stats (reporter-agent analog);
+                    # served through get_nodes / the dashboard node table
+                    n["stats"] = p["stats"]
         return {"ok": True}
 
     def rpc_get_nodes(self, p, conn):
         with self._lock:
             return {
                 nid: {k: n.get(k) for k in
-                      ("addr", "port", "resources", "alive", "labels", "shm_name")}
+                      ("addr", "port", "resources", "alive", "labels",
+                       "shm_name", "stats")}
                 for nid, n in self.nodes.items()
             }
 

@@ -1054,12 +1054,44 @@ class NodeDaemon:
 
     def _heartbeat_loop(self):
         period = self.config.health_check_period_ms / 1000.0
+        beats = 0
         while not self._stopped:
+            payload = {"node_id": self.node_id}
+            if beats % 5 == 0:  # physical stats every ~5th beat (psutil
+                payload["stats"] = self._sample_stats()  # calls are cheap
+            beats += 1                                   # but not free)
             try:
-                self.gcs.call("heartbeat", {"node_id": self.node_id}, timeout=5.0)
+                self.gcs.call("heartbeat", payload, timeout=5.0)
             except Exception:
                 pass
             time.sleep(period)
+
+    def _sample_stats(self) -> dict:
+        """Per-node physical stats riding the heartbeat (reference:
+        dashboard/modules/reporter/reporter_agent.py sampling psutil into
+        the GCS for the node views)."""
+        try:
+            import psutil
+        except ImportError:
+            return {}
+        out: dict = {"sampled_at": time.time()}
+        # each field sampled independently: one unavailable metric (e.g. no
+        # os.getloadavg on some platforms) must not blank the rest
+        for key, fn in (
+            ("cpu_percent", lambda: psutil.cpu_percent(interval=None)),
+            ("mem_used", lambda: int(psutil.virtual_memory().used)),
+            ("mem_total", lambda: int(psutil.virtual_memory().total)),
+            ("load_avg", os.getloadavg),
+            ("disk_percent", lambda: psutil.disk_usage("/").percent),
+            ("workers", lambda: len(self.workers)),
+            ("store_bytes",
+             lambda: self.store.stats().get("bytes_in_memory", 0)),
+        ):
+            try:
+                out[key] = fn()
+            except Exception:  # noqa: BLE001 - stats must never kill the beat
+                pass
+        return out
 
     def shutdown(self):
         self._stopped = True

@@ -4,6 +4,7 @@ Reference: python/ray/dashboard/modules/state/state_head.py routes.
 """
 
 import json
+import time
 import urllib.request
 
 import pytest
@@ -93,3 +94,18 @@ def test_events_not_duplicated_in_shared_process(dash):
     keys = [(e["timestamp"], e.get("pid"), e["label"], e.get("message"))
             for e in body]
     assert len(keys) == len(set(keys)), "duplicate events in merged view"
+
+
+def test_node_physical_stats(dash):
+    """Per-node psutil stats ride heartbeats into the node table
+    (reference: dashboard reporter agent)."""
+    pytest.importorskip("psutil")  # the feature degrades to {} without it
+    deadline = time.time() + 30
+    stats = {}
+    while time.time() < deadline:
+        body, _ = _get(dash.url + "/api/nodes")
+        stats = next((n.get("Stats") or {} for n in body), {})
+        if stats:
+            break
+        time.sleep(0.5)
+    assert "cpu_percent" in stats and stats["mem_total"] > 0, stats
