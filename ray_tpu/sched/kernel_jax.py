@@ -528,10 +528,14 @@ class JaxScheduler:
             return np.asarray(out)
         # Narrow-dtype device->host transfer: the dense [C, N] int32 result
         # is the round's dominant host link cost (10.5MB at 256x10240; the
-        # axon tunnel has been measured as low as ~35MB/s). Per-cell counts
-        # are almost always tiny, so downcast on device when a scalar max
-        # check (4-byte sync) proves it lossless — 4x/2x less on the wire.
-        m = int(out.max())
+        # axon tunnel has been measured as low as ~35MB/s). A class can
+        # place at most its own count on one node, so max(counts) bounds
+        # every cell HOST-side — no device sync needed to pick the dtype
+        # (the scalar max readback was itself a full round trip); the
+        # device max is only consulted when the host bound is too big.
+        m = int(np.max(counts, initial=0))
+        if m >= 32768:
+            m = int(out.max())
         if m < 256:
             return np.asarray(out.astype(jnp.uint8)).astype(np.int32)
         if m < 32768:
