@@ -530,11 +530,13 @@ class JaxScheduler:
         # is the round's dominant host link cost (10.5MB at 256x10240; the
         # axon tunnel has been measured as low as ~35MB/s). A class can
         # place at most its own count on one node, so max(counts) bounds
-        # every cell HOST-side — no device sync needed to pick the dtype
-        # (the scalar max readback was itself a full round trip); the
-        # device max is only consulted when the host bound is too big.
+        # every cell HOST-side; when that already proves uint8 the scalar
+        # device-max sync (a full round trip) is skipped entirely.
+        # Otherwise the exact device max is worth one sync: typical spreads
+        # put 0-1 task per cell, and uint8-vs-int16 is 2.6MB vs 5.2MB per
+        # round on the wire.
         m = int(np.max(counts, initial=0))
-        if m >= 32768:
+        if m >= 256:
             m = int(out.max())
         if m < 256:
             return np.asarray(out.astype(jnp.uint8)).astype(np.int32)
