@@ -20,6 +20,7 @@ from ray_tpu.core.task_spec import SchedulingStrategy, TaskSpec, new_id
 
 _global_lock = threading.Lock()
 _runtime = None
+_embedded_cluster = None
 
 
 def init(
@@ -29,21 +30,76 @@ def init(
     resources: Optional[Dict[str, float]] = None,
     _system_config: Optional[Dict[str, Any]] = None,
     ignore_reinit_error: bool = False,
+    cluster: bool = False,
+    num_nodes: int = 1,
+    resources_per_node: Optional[Dict[str, float]] = None,
+    config: Optional[Dict[str, Any]] = None,
     **kwargs,
 ):
     """Start (or connect to) the runtime.
 
     address=None -> local mode (one in-process node, reference local Ray);
     address="tcp://host:port" -> connect to a running cluster's control
-    service (multi-node mode, ray_tpu.cluster).
+    service (multi-node mode, ray_tpu.cluster);
+    cluster=True -> boot an EMBEDDED cluster (in-process GCS + num_nodes
+    daemons with resources_per_node, workers as real subprocesses) and
+    connect to it; shutdown() tears it down. The multi-process topology
+    without managing Cluster() by hand — e.g. what torch.distributed
+    worker groups need (local-mode actors are threads of one process).
     """
-    global _runtime
+    global _runtime, _embedded_cluster
+    if kwargs:
+        # silently swallowing typos/unsupported options sent callers to
+        # local mode while they believed a flag took effect
+        raise TypeError(f"init() got unexpected arguments: {sorted(kwargs)}")
     with _global_lock:
         if _runtime is not None:
             if ignore_reinit_error:
+                if cluster and _embedded_cluster is None:
+                    # returning the existing (possibly local-mode) runtime
+                    # would be the believed-a-flag-took-effect trap the
+                    # strict-kwargs check above exists to prevent
+                    raise RuntimeError(
+                        "init(cluster=True, ignore_reinit_error=True): the "
+                        "runtime is already initialized WITHOUT an embedded "
+                        "cluster; shutdown() first"
+                    )
                 return _runtime
             raise RuntimeError("ray_tpu.init() called twice; use shutdown() first")
-        config = set_global_config(_system_config)
+        if config and _system_config:
+            raise TypeError("pass config= or _system_config=, not both")
+        config_dict = config or _system_config
+        if cluster:
+            if address is not None:
+                raise TypeError("cluster=True boots its own cluster; "
+                                "drop address= or drop cluster=True")
+            from ray_tpu.core.config import Config
+            from ray_tpu.cluster.cluster_utils import Cluster
+
+            per_node = dict(resources_per_node or {})
+            # num_cpus/num_tpus/resources apply PER NODE here — silently
+            # dropping them would hang tasks that demand those resources
+            per_node.setdefault("CPU", float(num_cpus or 4))
+            if num_tpus is not None:
+                per_node.setdefault("TPU", float(num_tpus))
+            for k, v in (resources or {}).items():
+                per_node.setdefault(k, float(v))
+            n = max(int(num_nodes), 1)
+            c = Cluster(config=Config(config_dict or {}))
+            try:
+                for _ in range(n):
+                    c.add_node(
+                        num_cpus=per_node["CPU"],
+                        resources={k: v for k, v in per_node.items()
+                                   if k != "CPU"},
+                    )
+                c.wait_for_nodes(n)
+            except BaseException:
+                c.shutdown()  # never leak GCS/daemon subprocesses
+                raise
+            _embedded_cluster = c
+            address = c.address
+        config = set_global_config(config_dict)
         res = dict(resources or {})
         if num_tpus is not None:
             res["TPU"] = float(num_tpus)
@@ -77,11 +133,18 @@ def init(
 
 
 def shutdown():
-    global _runtime
+    global _runtime, _embedded_cluster
     with _global_lock:
-        if _runtime is not None:
-            _runtime.shutdown()
+        try:
+            if _runtime is not None:
+                _runtime.shutdown()
+        finally:
             _runtime = None
+            if _embedded_cluster is not None:
+                try:
+                    _embedded_cluster.shutdown()
+                finally:
+                    _embedded_cluster = None
 
 
 def is_initialized() -> bool:

@@ -17,6 +17,12 @@ from ray_tpu.air.config import (
 from ray_tpu.air.result import Result
 from ray_tpu.train.backend_executor import Backend, BackendExecutor
 from ray_tpu.train.jax_trainer import JaxBackend, JaxTrainer
+from ray_tpu.train.torch_trainer import (
+    TorchBackend,
+    TorchTrainer,
+    prepare_data_loader,
+    prepare_model,
+)
 from ray_tpu.train.jax_utils import (
     load_pytree,
     prepare_data_shard,
@@ -41,6 +47,10 @@ __all__ = [
     "FailureConfig",
     "JaxBackend",
     "JaxTrainer",
+    "TorchBackend",
+    "TorchTrainer",
+    "prepare_data_loader",
+    "prepare_model",
     "Result",
     "RunConfig",
     "ScalingConfig",

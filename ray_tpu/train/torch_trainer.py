@@ -1,0 +1,161 @@
+"""TorchTrainer: torch.distributed (gloo) data-parallel training.
+
+Reference: python/ray/train/torch/torch_trainer.py +
+train/torch/config.py (_TorchBackend: rank-0 is MASTER, every worker runs
+init_process_group) + train/torch/train_loop_utils.py (prepare_model ->
+DDP wrap, prepare_data_loader -> DistributedSampler). The TPU-native
+flagship path is JaxTrainer (jax_trainer.py — SPMD inside one program);
+this backend exists for torch workloads and uses gloo, the CPU collective
+the image ships (NCCL/GPU is out of scope here).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import ray_tpu
+from ray_tpu.train.backend_executor import Backend
+from ray_tpu.train.trainer import DataParallelTrainer
+from ray_tpu.train.worker_group import WorkerGroup
+
+
+class TorchBackend(Backend):
+    """Rendezvous: rank-0's host serves a TCP store; every worker joins the
+    process group before the training loop starts."""
+
+    def __init__(self, backend: str = "gloo", port: int = 0,
+                 timeout_s: float = 120.0):
+        self.backend = backend
+        if not port:
+            # pick a free port per backend instance: a fixed default would
+            # make two concurrent trainers on one host share a TCP store
+            # (duplicate ranks -> hang). Chosen here, before worker_env
+            # publishes MASTER_PORT.
+            import socket
+
+            with socket.socket() as s:
+                s.bind(("", 0))
+                port = s.getsockname()[1]
+        self.port = port
+        self.timeout_s = timeout_s
+
+    def on_start(self, worker_group: WorkerGroup, worker_infos: List[dict]):
+        master = worker_infos[0]["hostname"]
+        world = len(worker_infos)
+        if world > 1 and len({i["pid"] for i in worker_infos}) < world:
+            # local mode runs actors as threads of one process; a process
+            # group cannot form (rank 1 would see rank 0's init and bail,
+            # deadlocking rank 0's rendezvous). The reference never hits
+            # this because its workers are always processes.
+            raise RuntimeError(
+                "TorchTrainer with num_workers>1 needs cluster mode "
+                "(ray_tpu.init(cluster=True) or a real cluster): local "
+                "mode workers share one process and torch.distributed "
+                "requires one process per rank"
+            )
+
+        def _init(master_addr, port, world_size, rank, backend, timeout_s):
+            import datetime
+            import os
+            import socket
+
+            import torch.distributed as dist
+
+            if dist.is_available() and dist.is_initialized():
+                return True
+            try:
+                master_ip = socket.gethostbyname(master_addr)
+            except OSError:
+                master_ip = master_addr
+            if master_ip.startswith("127."):
+                # single-host group: gloo would otherwise advertise a
+                # non-loopback interface (whatever eth address exists) for
+                # peer pairing and hang at connectFullMesh
+                os.environ.setdefault("GLOO_SOCKET_IFNAME", "lo")
+                os.environ.setdefault("TP_SOCKET_IFNAME", "lo")
+            dist.init_process_group(
+                backend=backend,
+                init_method=f"tcp://{master_addr}:{port}",
+                world_size=world_size,
+                rank=rank,
+                timeout=datetime.timedelta(seconds=timeout_s),
+            )
+            return True
+
+        futs = [
+            w.run.remote(_init, master, self.port, world, rank,
+                         self.backend, self.timeout_s)
+            for rank, w in enumerate(worker_group.workers)
+        ]
+        ray_tpu.get(futs, timeout=self.timeout_s + 60)
+
+    def on_shutdown(self, worker_group: WorkerGroup):
+        def _destroy():
+            import torch.distributed as dist
+
+            if dist.is_available() and dist.is_initialized():
+                dist.destroy_process_group()
+            return True
+
+        try:
+            ray_tpu.get(
+                [w.run.remote(_destroy) for w in worker_group.workers],
+                timeout=30,
+            )
+        except Exception:  # noqa: BLE001 - teardown best-effort
+            pass
+
+    def worker_env(self, rank: int, worker_infos: List[dict]):
+        return {
+            "MASTER_ADDR": worker_infos[0]["hostname"],
+            "MASTER_PORT": str(self.port),
+            "WORLD_SIZE": str(len(worker_infos)),
+            "RANK": str(rank),
+        }
+
+
+def prepare_model(model):
+    """Wrap in DistributedDataParallel when a multi-worker group is up
+    (reference: train_loop_utils.prepare_model; gloo -> CPU DDP)."""
+    import torch.distributed as dist
+
+    if dist.is_available() and dist.is_initialized() and dist.get_world_size() > 1:
+        from torch.nn.parallel import DistributedDataParallel
+
+        return DistributedDataParallel(model)
+    return model
+
+
+def prepare_data_loader(loader):
+    """Re-shard a DataLoader across the group with a DistributedSampler
+    (reference: train_loop_utils.prepare_data_loader). Returns the loader
+    unchanged outside a group."""
+    import torch.distributed as dist
+
+    if not (dist.is_available() and dist.is_initialized()
+            and dist.get_world_size() > 1):
+        return loader
+    from torch.utils.data import DataLoader
+    from torch.utils.data.distributed import DistributedSampler
+
+    sampler = DistributedSampler(
+        loader.dataset,
+        num_replicas=dist.get_world_size(),
+        rank=dist.get_rank(),
+        shuffle=True,
+    )
+    return DataLoader(
+        loader.dataset,
+        batch_size=loader.batch_size,
+        sampler=sampler,
+        num_workers=0,
+        collate_fn=loader.collate_fn,
+        drop_last=loader.drop_last,
+    )
+
+
+class TorchTrainer(DataParallelTrainer):
+    def __init__(self, train_loop_per_worker, *,
+                 torch_backend: Optional[TorchBackend] = None, **kwargs):
+        kwargs.setdefault("backend", torch_backend or TorchBackend())
+        super().__init__(train_loop_per_worker, **kwargs)

@@ -11,7 +11,7 @@ from ray_tpu.util import graph
 
 @pytest.fixture()
 def local_ray():
-    ray_tpu.init(local=True)
+    ray_tpu.init()  # local mode
     yield
     ray_tpu.shutdown()
 
