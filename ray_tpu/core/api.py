@@ -99,30 +99,43 @@ def init(
                 raise
             _embedded_cluster = c
             address = c.address
-        config = set_global_config(config_dict)
-        res = dict(resources or {})
-        if num_tpus is not None:
-            res["TPU"] = float(num_tpus)
-        if address is None:
-            # worker processes inherit the cluster address (reference:
-            # RAY_ADDRESS / ray.init auto-connect inside workers)
-            import os as _os
+        try:
+            config = set_global_config(config_dict)
+            res = dict(resources or {})
+            if num_tpus is not None:
+                res["TPU"] = float(num_tpus)
+            if address is None:
+                # worker processes inherit the cluster address (reference:
+                # RAY_ADDRESS / ray.init auto-connect inside workers)
+                import os as _os
 
-            address = _os.environ.get("RAY_TPU_GCS_ADDR") or None
-        if address is None:
-            from ray_tpu.core.runtime import LocalRuntime
+                address = _os.environ.get("RAY_TPU_GCS_ADDR") or None
+            if address is None:
+                from ray_tpu.core.runtime import LocalRuntime
 
-            _runtime = LocalRuntime(num_cpus=num_cpus, resources=res, config=config)
-        else:
-            try:
-                from ray_tpu.cluster.client import ClusterClient
-            except ImportError as e:
-                _runtime = None
-                raise RuntimeError(
-                    "cluster mode (init(address=...)) is not available in this "
-                    "build"
-                ) from e
-            _runtime = ClusterClient(address, config=config)
+                _runtime = LocalRuntime(
+                    num_cpus=num_cpus, resources=res, config=config
+                )
+            else:
+                try:
+                    from ray_tpu.cluster.client import ClusterClient
+                except ImportError as e:
+                    raise RuntimeError(
+                        "cluster mode (init(address=...)) is not available "
+                        "in this build"
+                    ) from e
+                _runtime = ClusterClient(address, config=config)
+        except BaseException:
+            # a failure past the embedded-cluster boot must not strand its
+            # GCS/daemon/worker subprocesses (a retry would rebind
+            # _embedded_cluster and leak them permanently)
+            _runtime = None
+            if _embedded_cluster is not None:
+                try:
+                    _embedded_cluster.shutdown()
+                finally:
+                    _embedded_cluster = None
+            raise
         # opt-in tracing (reference: RAY_TRACING_ENABLED installing the
         # span wrappers at init)
         from ray_tpu.util import tracing as _tracing

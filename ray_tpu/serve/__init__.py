@@ -22,6 +22,7 @@ from ray_tpu.serve.deployment import (
     Deployment,
     deployment,
 )
+from ray_tpu.serve.batching import batch
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
 
 __all__ = [
@@ -29,6 +30,7 @@ __all__ = [
     "AutoscalingConfig",
     "Deployment",
     "DeploymentHandle",
+    "batch",
     "DeploymentResponse",
     "delete",
     "deployment",

@@ -1,0 +1,133 @@
+"""Dynamic request batching for deployment methods (@serve.batch).
+
+Reference: python/ray/serve/batching.py — concurrent calls to a decorated
+method are coalesced; the wrapped function receives a LIST of inputs and
+returns a LIST of outputs, one per caller. Batches flush when
+max_batch_size accumulates or batch_wait_timeout_s elapses since the
+first queued item.
+
+Replicas here are threaded actors (max_concurrency > 1), so batching is
+thread-rendezvous rather than asyncio: the first caller into an empty
+queue becomes the flusher — it sleeps out the window (or until the batch
+fills), takes the whole queue, runs the function once, and hands each
+caller its result through a per-item event.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from typing import Any, Callable, List, Optional
+
+
+class _Item:
+    __slots__ = ("value", "event", "result", "error")
+
+    def __init__(self, value):
+        self.value = value
+        self.event = threading.Event()
+        self.result = None
+        self.error: Optional[BaseException] = None
+
+
+class _Batcher:
+    def __init__(self, fn: Callable[..., List[Any]], max_batch_size: int,
+                 batch_wait_timeout_s: float):
+        self.fn = fn
+        self.max_batch_size = max_batch_size
+        self.timeout_s = batch_wait_timeout_s
+        self._lock = threading.Lock()
+        self._queue: List[_Item] = []
+        self._full = threading.Event()  # wakes the flusher early
+
+    def submit(self, bound_self, value):
+        item = _Item(value)
+        with self._lock:
+            self._queue.append(item)
+            leader = len(self._queue) == 1
+            if len(self._queue) >= self.max_batch_size:
+                self._full.set()
+        if leader:
+            self._drain(bound_self)
+        item.event.wait()
+        if item.error is not None:
+            raise item.error
+        return item.result
+
+    def _drain(self, bound_self):
+        """Leader loop: flush batches of AT MOST max_batch_size until the
+        queue is observed empty (arrivals during a flush have no leader of
+        their own — the election rule is queue-was-empty-at-append, so the
+        incumbent must drain them)."""
+        self._full.wait(timeout=self.timeout_s)
+        while True:
+            with self._lock:
+                batch = self._queue[: self.max_batch_size]
+                self._queue = self._queue[self.max_batch_size:]
+                if len(self._queue) < self.max_batch_size:
+                    self._full.clear()
+                if not batch:
+                    return
+            self._run_batch(bound_self, batch)
+
+    def _run_batch(self, bound_self, batch):
+        try:
+            args = [it.value for it in batch]
+            out = (self.fn(bound_self, args) if bound_self is not None
+                   else self.fn(args))
+            if not isinstance(out, (list, tuple)) or len(out) != len(batch):
+                raise TypeError(
+                    f"@serve.batch function must return a list of "
+                    f"{len(batch)} results (one per input); got {type(out)}"
+                )
+            for it, r in zip(batch, out):
+                it.result = r
+        except BaseException as e:  # noqa: BLE001 - delivered to callers
+            for it in batch:
+                it.error = e
+        finally:
+            for it in batch:
+                it.event.set()
+
+
+def batch(_func: Optional[Callable] = None, *, max_batch_size: int = 10,
+          batch_wait_timeout_s: float = 0.01):
+    """Decorate a deployment method (or function) taking a LIST of inputs
+    and returning a LIST of outputs; concurrent single-input calls are
+    coalesced into one invocation. Usable bare (@serve.batch) or with
+    arguments (@serve.batch(max_batch_size=..., batch_wait_timeout_s=...)).
+    """
+
+    def wrap(fn):
+        # one batcher per (instance, method): replicas must not share state
+        attr = f"__rt_batcher_{fn.__name__}"
+        attach_lock = threading.Lock()
+        module_level = _Batcher(fn, max_batch_size, batch_wait_timeout_s)
+
+        @functools.wraps(fn)
+        def method_wrapper(*args, **kwargs):
+            if kwargs:
+                raise TypeError("@serve.batch calls take one positional arg")
+            if len(args) == 2:  # bound method: (self, value)
+                inst, value = args
+                b = getattr(inst, attr, None)
+                if b is None:
+                    with attach_lock:  # two threads racing first use
+                        b = getattr(inst, attr, None)
+                        if b is None:
+                            b = _Batcher(
+                                fn, max_batch_size, batch_wait_timeout_s
+                            )
+                            setattr(inst, attr, b)
+                return b.submit(inst, value)
+            if len(args) == 1:  # plain function: (value,)
+                return module_level.submit(None, args[0])
+            raise TypeError("@serve.batch expects (self, value) or (value)")
+
+        method_wrapper._rt_is_batched = True
+        return method_wrapper
+
+    if _func is not None:
+        return wrap(_func)
+    return wrap

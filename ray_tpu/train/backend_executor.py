@@ -23,10 +23,17 @@ from ray_tpu.train.session import make_report_bus
 from ray_tpu.train.worker_group import WorkerGroup
 
 
+def _apply_env(env: Dict[str, str]):
+    import os
+
+    os.environ.update({str(k): str(v) for k, v in env.items()})
+    return True
+
+
 class Backend:
     """Hook interface (reference: train/backend/backend.py Backend).
-    on_start runs on the driver after rendezvous; on_training_start runs on
-    each worker before the loop."""
+    on_start runs on the driver after worker creation; worker_env(rank)
+    values are then exported into each worker's process environment."""
 
     def on_start(self, worker_group: WorkerGroup, worker_infos: List[dict]):
         pass
@@ -96,6 +103,18 @@ class BackendExecutor:
             )
         ray_tpu.get(setups)
         self.backend.on_start(self.worker_group, self.worker_infos)
+        # publish backend env vars into the worker processes AFTER on_start
+        # (rendezvous may pick ports on_start needs to know first); user
+        # loops then see e.g. the torch RANK/WORLD_SIZE/MASTER_* contract
+        envs = [
+            self.backend.worker_env(rank, self.worker_infos)
+            for rank in range(n)
+        ]
+        if any(envs):
+            ray_tpu.get([
+                w.run.remote(_apply_env, env)
+                for w, env in zip(self.worker_group.workers, envs)
+            ])
 
     def run_training(self, train_loop: Callable, config: Optional[dict]):
         """Kick off the loop on every worker; returns the per-worker futures."""

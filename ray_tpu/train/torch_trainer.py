@@ -26,22 +26,27 @@ class TorchBackend(Backend):
     def __init__(self, backend: str = "gloo", port: int = 0,
                  timeout_s: float = 120.0):
         self.backend = backend
-        if not port:
-            # pick a free port per backend instance: a fixed default would
-            # make two concurrent trainers on one host share a TCP store
-            # (duplicate ranks -> hang). Chosen here, before worker_env
-            # publishes MASTER_PORT.
-            import socket
-
-            with socket.socket() as s:
-                s.bind(("", 0))
-                port = s.getsockname()[1]
+        # 0 = pick a free port ON RANK-0's HOST at rendezvous (the store
+        # binds there, not on the driver; a fixed default would also make
+        # two concurrent trainers on one host share a TCP store). Probed
+        # then released — the standard racy-but-practical pattern.
         self.port = port
         self.timeout_s = timeout_s
 
     def on_start(self, worker_group: WorkerGroup, worker_infos: List[dict]):
         master = worker_infos[0]["hostname"]
         world = len(worker_infos)
+        if not self.port:
+            def _pick_port():
+                import socket
+
+                with socket.socket() as s:
+                    s.bind(("", 0))
+                    return s.getsockname()[1]
+
+            self.port = int(ray_tpu.get(
+                worker_group.workers[0].run.remote(_pick_port), timeout=60
+            ))
         if world > 1 and len({i["pid"] for i in worker_infos}) < world:
             # local mode runs actors as threads of one process; a process
             # group cannot form (rank 1 would see rank 0's init and bail,
@@ -126,25 +131,49 @@ def prepare_model(model):
     return model
 
 
+class _EpochedLoader:
+    """Iterates the sharded loader, bumping sampler.set_epoch each pass so
+    shuffle=True draws a fresh permutation per epoch (reference:
+    prepare_data_loader's epoch wrapping; without it DistributedSampler
+    replays the epoch-0 permutation forever)."""
+
+    def __init__(self, loader, sampler):
+        self._loader = loader
+        self._sampler = sampler
+        self._epoch = 0
+        self.batch_size = loader.batch_size
+        self.dataset = loader.dataset
+
+    def __iter__(self):
+        self._sampler.set_epoch(self._epoch)
+        self._epoch += 1
+        return iter(self._loader)
+
+    def __len__(self):
+        return len(self._loader)
+
+
 def prepare_data_loader(loader):
-    """Re-shard a DataLoader across the group with a DistributedSampler
-    (reference: train_loop_utils.prepare_data_loader). Returns the loader
-    unchanged outside a group."""
+    """Re-shard a DataLoader across the group with a DistributedSampler,
+    preserving the loader's own ordering choice (reference:
+    train_loop_utils.prepare_data_loader). Returns the loader unchanged
+    outside a group."""
     import torch.distributed as dist
 
     if not (dist.is_available() and dist.is_initialized()
             and dist.get_world_size() > 1):
         return loader
-    from torch.utils.data import DataLoader
+    from torch.utils.data import DataLoader, RandomSampler
     from torch.utils.data.distributed import DistributedSampler
 
     sampler = DistributedSampler(
         loader.dataset,
         num_replicas=dist.get_world_size(),
         rank=dist.get_rank(),
-        shuffle=True,
+        # keep the user's ordering: only shuffle if their loader did
+        shuffle=isinstance(loader.sampler, RandomSampler),
     )
-    return DataLoader(
+    sharded = DataLoader(
         loader.dataset,
         batch_size=loader.batch_size,
         sampler=sampler,
@@ -152,6 +181,7 @@ def prepare_data_loader(loader):
         collate_fn=loader.collate_fn,
         drop_last=loader.drop_last,
     )
+    return _EpochedLoader(sharded, sampler)
 
 
 class TorchTrainer(DataParallelTrainer):

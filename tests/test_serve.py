@@ -194,3 +194,74 @@ def test_http_get_with_query_string(ray8):
         f"http://127.0.0.1:{port}/ping?x=1", timeout=15
     ) as resp:
         assert json.loads(resp.read()) == {"ok": True}
+
+
+def test_serve_batch_coalesces_concurrent_requests(ray8):
+    """@serve.batch: concurrent calls arrive as ONE list invocation
+    (reference: python/ray/serve/batching.py)."""
+    import threading
+
+    from ray_tpu import serve
+
+    @serve.deployment(max_ongoing_requests=16)
+    class Doubler:
+        def __init__(self):
+            self.batch_sizes = []
+
+        @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.2)
+        def __call__(self, xs):
+            self.batch_sizes.append(len(xs))
+            return [x * 2 for x in xs]
+
+        def sizes(self):
+            return self.batch_sizes
+
+    h = serve.run(Doubler.bind(), name="batched")
+    results = [None] * 8
+    errs = []
+
+    def call(i):
+        try:
+            results[i] = h.remote(i).result(timeout=60)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=call, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=90)
+    assert not errs, errs
+    assert results == [i * 2 for i in range(8)]
+    sizes = h.sizes.remote().result(timeout=30)
+    assert sum(sizes) == 8
+    assert max(sizes) > 1, f"never batched: {sizes}"
+
+
+def test_serve_batch_respects_max_batch_size():
+    """Batches never exceed max_batch_size, and every caller gets its own
+    result even when arrivals outnumber one batch (leader drains)."""
+    import threading
+
+    from ray_tpu.serve.batching import _Batcher
+
+    sizes = []
+
+    def fn(xs):
+        sizes.append(len(xs))
+        return [x + 1 for x in xs]
+
+    b = _Batcher(fn, max_batch_size=8, batch_wait_timeout_s=0.2)
+    results = [None] * 30
+    threads = [
+        threading.Thread(target=lambda i=i: results.__setitem__(
+            i, b.submit(None, i)))
+        for i in range(30)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert results == [i + 1 for i in range(30)]
+    assert max(sizes) <= 8, sizes
+    assert sum(sizes) == 30

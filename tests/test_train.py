@@ -201,6 +201,11 @@ def test_torch_trainer_ddp_gloo(ray4):
         )
 
         assert dist.is_initialized() and dist.get_world_size() == 2
+        # the torch env contract is published into worker processes
+        import os as _os
+        assert _os.environ["WORLD_SIZE"] == "2"
+        assert int(_os.environ["RANK"]) == dist.get_rank()
+        assert _os.environ["MASTER_PORT"] not in ("", "0")
         rank = dist.get_rank()
         torch.manual_seed(0)  # same init on both replicas
         model = prepare_model(torch.nn.Linear(4, 1))
