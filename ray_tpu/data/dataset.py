@@ -507,6 +507,29 @@ class Dataset:
                 sub = acc.slice(s, min(s + batch_size, acc.num_rows()))
                 yield BlockAccessor(sub).to_batch(batch_format)
 
+    def iter_torch_batches(
+        self, *, batch_size: int = 256, dtypes=None, device: str = "cpu"
+    ) -> Iterator[Any]:
+        """Batches as dicts of torch tensors (reference:
+        Dataset.iter_torch_batches; numpy batches zero-copy into
+        torch.from_numpy where dtypes permit)."""
+        import torch
+
+        for batch in self.iter_batches(
+            batch_size=batch_size, batch_format="numpy"
+        ):
+            out = {}
+            for k, v in batch.items():
+                t = torch.from_numpy(np.ascontiguousarray(v))
+                if dtypes is not None:
+                    want = dtypes.get(k) if isinstance(dtypes, dict) else dtypes
+                    if want is not None:
+                        t = t.to(want)
+                if device != "cpu":
+                    t = t.to(device)
+                out[k] = t
+            yield out
+
     def take(self, n: int = 20) -> List[Any]:
         return list(itertools.islice(self.iter_rows(), n))
 

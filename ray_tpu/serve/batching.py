@@ -40,12 +40,20 @@ class _Batcher:
         self._lock = threading.Lock()
         self._queue: List[_Item] = []
         self._full = threading.Event()  # wakes the flusher early
+        self._leading = False  # exactly one drain loop at a time
 
     def submit(self, bound_self, value):
         item = _Item(value)
         with self._lock:
             self._queue.append(item)
-            leader = len(self._queue) == 1
+            # leadership is a flag, NOT queue-was-empty: the incumbent
+            # empties the queue before running the batch, so an arrival
+            # mid-flush would otherwise elect a second leader and run the
+            # batch function concurrently — @serve.batch exists precisely
+            # for non-thread-safe model state
+            leader = not self._leading
+            if leader:
+                self._leading = True
             if len(self._queue) >= self.max_batch_size:
                 self._full.set()
         if leader:
@@ -57,9 +65,8 @@ class _Batcher:
 
     def _drain(self, bound_self):
         """Leader loop: flush batches of AT MOST max_batch_size until the
-        queue is observed empty (arrivals during a flush have no leader of
-        their own — the election rule is queue-was-empty-at-append, so the
-        incumbent must drain them)."""
+        queue is observed empty; leadership is handed off under the same
+        lock acquisition that observes emptiness."""
         self._full.wait(timeout=self.timeout_s)
         while True:
             with self._lock:
@@ -68,6 +75,7 @@ class _Batcher:
                 if len(self._queue) < self.max_batch_size:
                     self._full.clear()
                 if not batch:
+                    self._leading = False
                     return
             self._run_batch(bound_self, batch)
 

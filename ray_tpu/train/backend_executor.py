@@ -106,15 +106,25 @@ class BackendExecutor:
         # publish backend env vars into the worker processes AFTER on_start
         # (rendezvous may pick ports on_start needs to know first); user
         # loops then see e.g. the torch RANK/WORLD_SIZE/MASTER_* contract
+        import os as _os
+
+        driver_pid = _os.getpid()
         envs = [
             self.backend.worker_env(rank, self.worker_infos)
             for rank in range(n)
         ]
-        if any(envs):
-            ray_tpu.get([
-                w.run.remote(_apply_env, env)
-                for w, env in zip(self.worker_group.workers, envs)
-            ])
+        # apply only to workers in their OWN processes: local-mode workers
+        # are threads of this process, where per-rank env would clobber the
+        # driver's environment (and each other, last-rank-wins)
+        calls = [
+            w.run.remote(_apply_env, env)
+            for w, env, info in zip(
+                self.worker_group.workers, envs, self.worker_infos
+            )
+            if env and info.get("pid") != driver_pid
+        ]
+        if calls:
+            ray_tpu.get(calls)
 
     def run_training(self, train_loop: Callable, config: Optional[dict]):
         """Kick off the loop on every worker; returns the per-worker futures."""

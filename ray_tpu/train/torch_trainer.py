@@ -145,12 +145,19 @@ class _EpochedLoader:
         self.dataset = loader.dataset
 
     def __iter__(self):
+        # each pass IS an epoch; manual sampler.set_epoch is unnecessary
+        # (and would be overridden here)
         self._sampler.set_epoch(self._epoch)
         self._epoch += 1
         return iter(self._loader)
 
     def __len__(self):
         return len(self._loader)
+
+    def __getattr__(self, name):
+        # delegate everything else (sampler, num_workers, pin_memory, ...)
+        # so code written against a real DataLoader keeps working
+        return getattr(self._loader, name)
 
 
 def prepare_data_loader(loader):

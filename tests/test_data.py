@@ -362,3 +362,18 @@ def test_read_parquet_kwargs_forwarded(data, tmp_path):
     )
     out = data.read_parquet(str(tmp_path / "t.parquet"), columns=["a"]).take_all()
     assert out == [{"a": 1}, {"a": 2}, {"a": 3}]
+
+
+def test_iter_torch_batches(data):
+    import torch
+
+    ds = ray_tpu.data.from_items(
+        [{"x": float(i), "y": i} for i in range(100)], parallelism=4
+    )
+    seen = 0
+    for batch in ds.iter_torch_batches(batch_size=32, dtypes={"x": torch.float32}):
+        assert isinstance(batch["x"], torch.Tensor)
+        assert batch["x"].dtype == torch.float32
+        assert batch["y"].dtype in (torch.int64, torch.int32)
+        seen += len(batch["x"])
+    assert seen == 100

@@ -265,3 +265,36 @@ def test_serve_batch_respects_max_batch_size():
     assert results == [i + 1 for i in range(30)]
     assert max(sizes) <= 8, sizes
     assert sum(sizes) == 30
+
+
+def test_serve_batch_never_concurrent():
+    """The batch function must never run concurrently on one batcher (the
+    point of batching is single-threaded model access)."""
+    import threading
+    import time as _time
+
+    from ray_tpu.serve.batching import _Batcher
+
+    active = [0]
+    peak = [0]
+    guard = threading.Lock()
+
+    def fn(xs):
+        with guard:
+            active[0] += 1
+            peak[0] = max(peak[0], active[0])
+        _time.sleep(0.05)
+        with guard:
+            active[0] -= 1
+        return xs
+
+    b = _Batcher(fn, max_batch_size=2, batch_wait_timeout_s=0.01)
+    threads = []
+    for i in range(8):
+        t = threading.Thread(target=lambda i=i: b.submit(None, i))
+        t.start()
+        threads.append(t)
+        _time.sleep(0.02)  # staggered arrivals during flushes
+    for t in threads:
+        t.join(timeout=30)
+    assert peak[0] == 1, f"batch fn ran {peak[0]}-way concurrent"
