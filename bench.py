@@ -90,6 +90,13 @@ def _bench_kernel_round(sched, demands, counts, reps=5):
         r = sched.schedule(demands, k, algo=ALGO)
         ts.append(time.perf_counter() - t0)
     placed = int(r.sum())
+    # standing TPU-numerics guard (see kernel_jax module docstring): fast
+    # division may shift decisions +-1 vs the NumPy twin, but placements
+    # must never exceed per-class demand or node capacity
+    assert (r.sum(axis=1) <= k).all(), "kernel overplaced a class on TPU"
+    used = r.astype(np.float32).T @ demands
+    total_np = np.asarray(sched.total)
+    assert (used <= total_np + 1e-2).all(), "kernel exceeded capacity on TPU"
     return float(np.median(ts)), placed
 
 
@@ -240,6 +247,11 @@ def config_5(dev):
     sched = JaxScheduler(total, alive, device=dev)
     sched.set_available(total * alive[:, None])
 
+    # host mirror of device availability, for the standing TPU-numerics
+    # invariant guard (see kernel_jax docstring): placements must never
+    # exceed what is actually free
+    host_avail = (total * alive[:, None]).astype(np.float32)
+
     chunks = 10
     arrivals = [np.floor(counts / chunks).astype(np.int32)] * (chunks - 1)
     arrivals.append((counts - np.sum(arrivals, axis=0)).astype(np.int32))
@@ -259,6 +271,7 @@ def config_5(dev):
             for a in due:
                 release += a.astype(np.float32).T @ demands
             sched.apply_delta(release)
+            host_avail = np.minimum(host_avail + release, total)
         if rnd < len(arrivals):
             backlog = backlog + arrivals[rnd]
         # autoscaler: persistent backlog (beyond one arrival chunk) brings
@@ -270,12 +283,18 @@ def config_5(dev):
             sched.alive = jax.device_put(alive, sched.device)
             idx = list(range(up.start, up.stop))
             sched.update_rows(idx, total[idx])
+            host_avail[idx] = total[idx]
             scaled_up_at = rnd
         if backlog.sum() > 0:
             t0 = time.perf_counter()
             assigned = sched.schedule(demands, backlog, algo=ALGO)
             sched_times.append(time.perf_counter() - t0)
             placed_c = assigned.sum(axis=1).astype(np.int32)
+            assert (placed_c <= backlog).all(), "stream overplaced a class"
+            used_round = assigned.astype(np.float32).T @ demands
+            assert (used_round <= host_avail + 1e-2).all(), \
+                "stream exceeded capacity"
+            host_avail = np.maximum(host_avail - used_round, 0.0)
             backlog = backlog - placed_c
             total_decisions += int(placed_c.sum())
             if placed_c.sum() > 0:
