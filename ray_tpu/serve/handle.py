@@ -17,13 +17,40 @@ import ray_tpu
 
 class DeploymentResponse:
     """Future-like wrapper over the underlying ObjectRef (reference:
-    serve.handle.DeploymentResponse)."""
+    serve.handle.DeploymentResponse). If the chosen replica died before
+    answering (scale-down, crash), result() resubmits to a live replica —
+    the reference router's retry-on-dead-replica behavior; requests are
+    assumed safe to re-run, as there."""
 
-    def __init__(self, ref):
+    MAX_DEAD_REPLICA_RETRIES = 3
+
+    def __init__(self, ref, resubmit=None):
         self._ref = ref
+        self._resubmit = resubmit
+        self._retries_left = self.MAX_DEAD_REPLICA_RETRIES
 
     def result(self, timeout: Optional[float] = None):
-        return ray_tpu.get(self._ref, timeout=timeout)
+        import time as _time
+
+        from ray_tpu.core.exceptions import ActorDiedError
+
+        # one overall deadline across retries — a rolling rescale must not
+        # multiply the caller's timeout by the retry budget
+        deadline = None if timeout is None else _time.time() + timeout
+        while True:
+            remaining = (
+                None if deadline is None
+                else max(deadline - _time.time(), 0.01)
+            )
+            try:
+                out = ray_tpu.get(self._ref, timeout=remaining)
+                self._resubmit = None  # free the pinned request payload
+                return out
+            except ActorDiedError:
+                if self._resubmit is None or self._retries_left <= 0:
+                    raise
+                self._retries_left -= 1
+                self._ref = self._resubmit()
 
     @property
     def ref(self):
@@ -97,13 +124,19 @@ class DeploymentHandle:
         if v != self._replica_version:
             self._refresh_replicas()
 
-    def _pick_replica(self):
+    def _pick_replica(self, exclude: Optional[set] = None):
         """Power of two choices on locally-observed in-flight counts
         (reference: pow_2_scheduler.py). Returns the replica handle —
         chosen and read under ONE lock so a concurrent refresh can't
-        invalidate the index."""
+        invalidate the index. `exclude`: actor ids known dead (a crashed
+        replica stays in stale membership looking idle — pow-2 would be
+        biased TOWARD it)."""
         with self._lock:
-            n = len(self._replicas)
+            replicas = self._replicas
+            if exclude:
+                alive = [r for r in replicas if r._actor_id not in exclude]
+                replicas = alive or replicas  # all excluded: last resort
+            n = len(replicas)
             if n == 0:
                 raise RuntimeError(
                     f"deployment {self.deployment_name} has no replicas"
@@ -116,24 +149,45 @@ class DeploymentHandle:
                     )
                     self._inflight[aid] = list(pending)
             if n == 1:
-                return self._replicas[0]
+                return replicas[0]
             a, b = self._rng.sample(range(n), 2)
-            ra, rb = self._replicas[a], self._replicas[b]
+            ra, rb = replicas[a], replicas[b]
             la = len(self._inflight.get(ra._actor_id, ()))
             lb = len(self._inflight.get(rb._actor_id, ()))
             return ra if la <= lb else rb
 
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
+    def _submit(self, args, kwargs, exclude: Optional[set] = None):
         self._maybe_refresh()
         try:
-            replica = self._pick_replica()
+            replica = self._pick_replica(exclude)
         except RuntimeError:
             self._maybe_refresh(force=True)  # empty set may be stale
-            replica = self._pick_replica()
+            replica = self._pick_replica(exclude)
         ref = replica.handle_request.remote(self._method_name, args, kwargs)
         with self._lock:
             self._inflight.setdefault(replica._actor_id, []).append(ref)
-        return DeploymentResponse(ref)
+        return ref, replica._actor_id
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        ref, aid = self._submit(args, kwargs)
+        dead: set = set()  # populated by resubmit as deaths occur
+        last = [aid]
+
+        def resubmit():
+            # the replica that just died may still sit in stale membership
+            # (a crash bumps no controller version — version-gated refresh
+            # would be a no-op), so fetch membership unconditionally AND
+            # exclude known-dead replicas from the pick
+            dead.add(last[0])
+            try:
+                self._refresh_replicas()
+            except Exception:  # noqa: BLE001 - controller mid-restart
+                pass
+            ref, aid2 = self._submit(args, kwargs, exclude=dead)
+            last[0] = aid2
+            return ref
+
+        return DeploymentResponse(ref, resubmit=resubmit)
 
     def __getattr__(self, name: str):
         if name.startswith("_"):

@@ -298,3 +298,49 @@ def test_serve_batch_never_concurrent():
     for t in threads:
         t.join(timeout=30)
     assert peak[0] == 1, f"batch fn ran {peak[0]}-way concurrent"
+
+
+def test_handle_retries_on_dead_replica(ray8):
+    """Scale-down/crash mid-request: result() resubmits to a live replica
+    (reference: the router's retry-on-dead-replica)."""
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=3)
+    class Sq:
+        def __call__(self, x):
+            return x * x
+
+    h = serve.run(Sq.bind(), name="retry")
+    assert h.remote(3).result(timeout=30) == 9
+    # rescale down: two of the three replicas die while the handle still
+    # holds the old membership
+    serve.run(Sq.options(num_replicas=1).bind(), name="retry")
+    ok = 0
+    for i in range(40):
+        assert h.remote(i).result(timeout=30) == i * i
+        ok += 1
+    assert ok == 40
+
+
+def test_handle_retries_on_crashed_replica_without_rescale(ray8):
+    """A replica CRASH bumps no controller version; the handle must still
+    route around the dead actor (exclusion + unconditional refresh)."""
+    from ray_tpu import serve
+    from ray_tpu.serve.api import _get_controller
+
+    @serve.deployment(num_replicas=2)
+    class Sq:
+        def __call__(self, x):
+            return x + 100
+
+    h = serve.run(Sq.bind(), name="crash")
+    assert h.remote(1).result(timeout=30) == 101
+    # kill one replica actor directly — no rescale, version unchanged
+    ctrl = _get_controller()
+    reps = ray_tpu.get(ctrl.get_replicas.remote("crash", "Sq"))["replicas"]
+    ray_tpu.kill(reps[0])
+    ok = 0
+    for i in range(30):
+        assert h.remote(i).result(timeout=30) == i + 100
+        ok += 1
+    assert ok == 30
