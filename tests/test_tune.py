@@ -253,3 +253,31 @@ def test_restore_preserves_stop_criteria(ray8, tmp_path):
         json.dump({"metric": "m", "mode": "max", "stop": {"training_iteration": 7}}, f)
     t = tune.Tuner.restore(meta_dir, lambda c: None)
     assert t.run_config.stop == {"training_iteration": 7}
+
+
+def test_crashing_trials_dont_corrupt_experiment(ray8):
+    """Flaky trainables: crashes surface as per-trial errors, surviving
+    trials complete, and the best result is still the true optimum."""
+    def trainable(config):
+        if config["crash"] and config["q"] < 0.5:
+            raise RuntimeError("boom")
+        for i in range(1, 9):
+            tune.report({"score": config["q"] * i})
+
+    qs = [0.1, 0.3, 0.45, 0.6, 0.8, 0.95]
+    res = tune.Tuner(
+        trainable,
+        param_space={
+            "q": tune.grid_search(qs),
+            "crash": tune.grid_search([False, True]),
+        },
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max",
+            scheduler=tune.ASHAScheduler(max_t=8, grace_period=2),
+            max_concurrent_trials=4,
+        ),
+        run_config=RunConfig(name="flaky", storage_path=ray8),
+    ).fit()
+    crashed = sum(1 for r in res if r.error is not None)
+    assert crashed == 3  # q in {0.1, 0.3, 0.45} with crash=True
+    assert res.get_best_result().metrics["config"]["q"] == 0.95
