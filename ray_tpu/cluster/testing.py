@@ -48,6 +48,12 @@ def register_fake_nodes(gcs, n_nodes: int,
             },
             FakeConn(conn_id=10_000 + i),
         )
+        # fake nodes have no daemon to heartbeat: a harness run outlasting
+        # health_check_timeout_ms (5s default — easily exceeded by a
+        # loaded host or a big benchmark) would see its cluster declared
+        # dead mid-run and lose placements. Make them immortal.
+        with gcs._lock:
+            gcs.nodes[f"node-{i}"]["last_beat"] = time.time() + 10 ** 9
 
 
 def complete_running(gcs, task_ids) -> None:
