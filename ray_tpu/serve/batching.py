@@ -6,11 +6,14 @@ returns a LIST of outputs, one per caller. Batches flush when
 max_batch_size accumulates or batch_wait_timeout_s elapses since the
 first queued item.
 
-Replicas here are threaded actors (max_concurrency > 1), so batching is
-thread-rendezvous rather than asyncio: the first caller into an empty
-queue becomes the flusher — it sleeps out the window (or until the batch
-fills), takes the whole queue, runs the function once, and hands each
-caller its result through a per-item event.
+Replicas here are threaded actors (max_concurrency > 1), so batching is a
+thread rendezvous: callers enqueue and block on a per-item event; one
+dedicated flusher thread per batcher (the analog of the reference's
+asyncio flush task) waits out each batch's window — anchored to the
+OLDEST queued item's arrival time — and runs the function. A dedicated
+flusher means no caller is ever held past its own result to serve later
+arrivals' windows, and every trailing batch still gets its full
+coalescing window.
 """
 
 from __future__ import annotations
@@ -18,17 +21,19 @@ from __future__ import annotations
 import functools
 import threading
 import time
+import weakref
 from typing import Any, Callable, List, Optional
 
 
 class _Item:
-    __slots__ = ("value", "event", "result", "error")
+    __slots__ = ("value", "event", "result", "error", "t")
 
     def __init__(self, value):
         self.value = value
         self.event = threading.Event()
         self.result = None
         self.error: Optional[BaseException] = None
+        self.t = time.monotonic()  # arrival, anchors the batch window
 
 
 class _Batcher:
@@ -37,47 +42,66 @@ class _Batcher:
         self.fn = fn
         self.max_batch_size = max_batch_size
         self.timeout_s = batch_wait_timeout_s
-        self._lock = threading.Lock()
+        self._cv = threading.Condition()
         self._queue: List[_Item] = []
-        self._full = threading.Event()  # wakes the flusher early
-        self._leading = False  # exactly one drain loop at a time
+        # weakref: the daemon flusher thread outlives dropped replicas,
+        # and a strong ref here would keep their model state alive forever
+        self._bound_ref = None
+        self._thread: Optional[threading.Thread] = None
 
     def submit(self, bound_self, value):
         item = _Item(value)
-        with self._lock:
+        with self._cv:
             self._queue.append(item)
-            # leadership is a flag, NOT queue-was-empty: the incumbent
-            # empties the queue before running the batch, so an arrival
-            # mid-flush would otherwise elect a second leader and run the
-            # batch function concurrently — @serve.batch exists precisely
-            # for non-thread-safe model state
-            leader = not self._leading
-            if leader:
-                self._leading = True
-            if len(self._queue) >= self.max_batch_size:
-                self._full.set()
-        if leader:
-            self._drain(bound_self)
+            if bound_self is not None and self._bound_ref is None:
+                try:
+                    self._bound_ref = weakref.ref(bound_self)
+                except TypeError:  # __slots__ without __weakref__
+                    self._bound_ref = lambda inst=bound_self: inst
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._loop, daemon=True,
+                    name=f"serve-batch-{self.fn.__name__}",
+                )
+                self._thread.start()
+            self._cv.notify()
         item.event.wait()
         if item.error is not None:
             raise item.error
         return item.result
 
-    def _drain(self, bound_self):
-        """Leader loop: flush batches of AT MOST max_batch_size until the
-        queue is observed empty; leadership is handed off under the same
-        lock acquisition that observes emptiness."""
-        self._full.wait(timeout=self.timeout_s)
+    # how long an empty-queue flusher lingers before exiting; submit()
+    # restarts it. Bounds the thread count for replica churn: a dropped
+    # replica's flusher parks at most this long instead of forever.
+    _IDLE_EXIT_S = 10.0
+
+    def _loop(self):
+        """Flusher: sleep until the oldest item's window elapses or the
+        queue fills, take one batch, run it, repeat. Only this thread
+        removes items, so `self._queue[0]` stays valid across waits.
+        Exits after _IDLE_EXIT_S of empty queue (handing `self._thread`
+        back under the cv, so a racing submit starts a fresh one)."""
         while True:
-            with self._lock:
+            with self._cv:
+                idle_deadline = time.monotonic() + self._IDLE_EXIT_S
+                while not self._queue:
+                    remaining = idle_deadline - time.monotonic()
+                    if remaining <= 0:
+                        self._thread = None
+                        return
+                    self._cv.wait(timeout=remaining)
+                deadline = self._queue[0].t + self.timeout_s
+                while len(self._queue) < self.max_batch_size:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cv.wait(timeout=remaining)
                 batch = self._queue[: self.max_batch_size]
                 self._queue = self._queue[self.max_batch_size:]
-                if len(self._queue) < self.max_batch_size:
-                    self._full.clear()
-                if not batch:
-                    self._leading = False
-                    return
-            self._run_batch(bound_self, batch)
+                # queued items imply a caller thread blocked inside the
+                # instance's method, so the weakref cannot be dead here
+                bound = self._bound_ref() if self._bound_ref else None
+            self._run_batch(bound, batch)
 
     def _run_batch(self, bound_self, batch):
         try:

@@ -107,21 +107,25 @@ class BackendExecutor:
         # (rendezvous may pick ports on_start needs to know first); user
         # loops then see e.g. the torch RANK/WORLD_SIZE/MASTER_* contract
         import os as _os
+        import socket as _socket
 
-        driver_pid = _os.getpid()
+        driver_ident = (_socket.gethostname(), _os.getpid())
         envs = [
             self.backend.worker_env(rank, self.worker_infos)
             for rank in range(n)
         ]
         # apply only to workers in their OWN processes: local-mode workers
         # are threads of this process, where per-rank env would clobber the
-        # driver's environment (and each other, last-rank-wins)
+        # driver's environment (and each other, last-rank-wins). Identity is
+        # (hostname, pid) — a bare pid can collide with the driver's on a
+        # different host.
         calls = [
             w.run.remote(_apply_env, env)
             for w, env, info in zip(
                 self.worker_group.workers, envs, self.worker_infos
             )
-            if env and info.get("pid") != driver_pid
+            if env
+            and (info.get("hostname"), info.get("pid")) != driver_ident
         ]
         if calls:
             ray_tpu.get(calls)
