@@ -11,12 +11,47 @@ BASELINE.json's north star.
 
 from __future__ import annotations
 
+import logging
 from typing import Optional, Tuple
 
 import numpy as np
 
 from ray_tpu.sched import kernel_np
 from ray_tpu.sched.resources import NodeResourceState
+
+logger = logging.getLogger(__name__)
+
+
+def _invariant_violation(avail, demands, counts, assigned):
+    """Check a round's assignment against the two safety invariants.
+
+    Returns (error, taken): error is None when the assignment is safe,
+    else a short description of the violated invariant; taken is the
+    [N, R] usage matrix (computed here anyway, reused by the caller to
+    update availability — the matmul is the expensive part at 10k nodes).
+    `avail` is the PRE-round availability [N, R]. A small relative
+    tolerance absorbs legitimate float32 subtraction noise; real kernel
+    faults (over-assignment) exceed it by whole demand units.
+    """
+    if (assigned < 0).any():
+        return "negative assignment count", None
+    per_class = assigned.sum(axis=1)
+    if (per_class > np.asarray(counts)).any():
+        c = int(np.argmax(per_class - np.asarray(counts)))
+        return (f"assigned > demand for class {c} "
+                f"({int(per_class[c])} > {int(counts[c])})"), None
+    taken = assigned.astype(np.float32).T @ demands  # [N, R]
+    # tolerance scaled to float32 rounding (~32 ulp), NOT a fixed relative
+    # fraction: large-magnitude resources (memory in bytes, ~2**33) would
+    # otherwise get a tolerance bigger than a whole task's demand and real
+    # over-commits would pass silently
+    tol = 32.0 * np.finfo(np.float32).eps * np.maximum(avail, 1.0)
+    over = taken > avail + tol
+    if over.any():
+        n, r = np.unravel_index(int(np.argmax(over)), over.shape)
+        return (f"usage > availability at node {n} resource {r} "
+                f"({taken[n, r]:.6g} > {avail[n, r]:.6g})"), taken
+    return None, taken
 
 
 class SchedulingPolicy:
@@ -141,12 +176,29 @@ class HybridPolicy(SchedulingPolicy):
             assigned = sched.schedule(
                 demands_o, counts_o, self.spread_threshold, algo=self.algo
             )[inv]
-            # keep the host view authoritative (device copy is a cache);
-            # this assignment bypasses dirty tracking on purpose — the
-            # device already holds the post-schedule view (kernel output)
-            taken = assigned.astype(np.float32).T @ demands  # [N, R]
-            state.available = np.maximum(state.available - taken, 0.0)
-            return assigned
+            # Live-path numerics guard: the TPU kernel's fast division can
+            # shift decisions ±1 at exact-capacity boundaries
+            # (kernel_jax.py header note). The two safety invariants —
+            # assigned ≤ demand per class, usage ≤ availability per node —
+            # must hold on EVERY live round, not just in bench.py. On
+            # violation: log, discard the device result, force a full
+            # device re-sync, and serve this round from the NumPy twin.
+            err, taken = _invariant_violation(
+                state.available, demands, counts, assigned
+            )
+            if err is None:
+                # keep the host view authoritative (device copy is a
+                # cache); this assignment bypasses dirty tracking on
+                # purpose — the device already holds the post-schedule
+                # view (kernel output)
+                state.available = np.maximum(state.available - taken, 0.0)
+                return assigned
+            logger.warning(
+                "jax_tpu device round violated scheduling invariant (%s); "
+                "falling back to the NumPy twin for this round", err
+            )
+            # fall through: the backend=="jax" branch below forces the full
+            # device re-sync, and the NumPy path serves this round
         if self.backend == "jax":
             # small round on the NumPy twin: the device availability cache
             # goes stale, so force a full re-upload before the next

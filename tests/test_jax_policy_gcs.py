@@ -160,3 +160,72 @@ def test_policy_incremental_sync_equality():
             vec[0] = float(rng.integers(1, 3))
             st_a.release(i, vec)
             st_b.release(i, vec)
+
+
+def _fresh_state(n=8, cpu=8):
+    space = ResourceSpace()
+    st = NodeResourceState(space=space)
+    for i in range(n):
+        st.add_node(f"n{i}", {"CPU": cpu})
+    return st
+
+
+@pytest.mark.parametrize("fault", ["over_demand", "over_capacity"])
+def test_jax_policy_invariant_guard_fallback(monkeypatch, caplog, fault):
+    """Fault injection for the live-path numerics guard: a corrupted device
+    result (over-assignment vs demand, or vs node capacity) must be
+    detected, logged, and replaced by the NumPy twin's answer for the
+    round — never applied to the cluster view (kernel_jax.py header note:
+    TPU fast division can shift boundary decisions)."""
+    import logging
+
+    demands = np.zeros((2, 16), np.float32)
+    demands[0, 0] = 1.0
+    demands[1, 0] = 2.0
+    counts = np.array([5, 3], np.int32)
+
+    # ground truth from the NumPy policy on an identical fresh state
+    st_ref = _fresh_state()
+    pol_ref = make_policy_from_config(Config({"scheduling_policy": "hybrid"}))
+    expected = pol_ref.schedule(st_ref, demands.copy(), counts.copy())
+
+    def bad_schedule(self, demands, counts, spread_threshold, algo="scan"):
+        out = np.zeros((demands.shape[0], int(self.total.shape[0])), np.int32)
+        if fault == "over_demand":
+            out[:, 0] = np.asarray(counts) + 1  # more tasks than demanded
+        else:
+            # within per-class demand but node 0 (8 CPUs) gets 5x1 + 3x2
+            # = 11 CPUs of usage
+            out[0, 0] = 5
+            out[1, 0] = 3
+        return out
+
+    monkeypatch.setattr(JaxScheduler, "schedule", bad_schedule)
+    st = _fresh_state()
+    pol = make_policy_from_config(
+        Config({"scheduling_policy": "jax_tpu", "jax_policy_min_cells": 0})
+    )
+    with caplog.at_level(logging.WARNING, logger="ray_tpu.sched.policy"):
+        got = pol.schedule(st, demands.copy(), counts.copy())
+    assert "invariant" in caplog.text
+    np.testing.assert_array_equal(got, expected)
+    np.testing.assert_allclose(st.available, st_ref.available, atol=1e-5)
+
+
+def test_jax_policy_guard_passes_clean_rounds(caplog):
+    """The guard must be silent on healthy device rounds (no false
+    positives from float32 subtraction noise)."""
+    import logging
+
+    st = _fresh_state(n=16, cpu=16)
+    pol = make_policy_from_config(
+        Config({"scheduling_policy": "jax_tpu", "jax_policy_min_cells": 0})
+    )
+    rng = np.random.default_rng(3)
+    with caplog.at_level(logging.WARNING, logger="ray_tpu.sched.policy"):
+        for _ in range(8):
+            demands = np.zeros((3, 16), np.float32)
+            demands[:, 0] = rng.integers(1, 4, 3)
+            counts = rng.integers(0, 10, 3).astype(np.int32)
+            pol.schedule(st, demands, counts)
+    assert "invariant" not in caplog.text
