@@ -1279,6 +1279,11 @@ class ClusterClient:
     def list_tasks(self, limit: int = 1000) -> List[dict]:
         return self.gcs.call("list_tasks", {"limit": limit})
 
+    def summarize_tasks(self) -> dict:
+        """Full-history per-name/status counts from the GCS's incremental
+        aggregates — exact at any task count, unlike listing events."""
+        return self.gcs.call("summarize_tasks", {})
+
     def list_actors(self) -> List[dict]:
         return self.gcs.call("list_actors", {})
 

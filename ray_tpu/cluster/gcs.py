@@ -13,6 +13,7 @@ of ClusterTaskManager/ClusterResourceScheduler (src/ray/raylet/scheduling/).
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 import traceback
@@ -26,6 +27,7 @@ from ray_tpu.cluster.rpc import RpcClient, RpcServer
 from ray_tpu.sched.policy import make_policy_from_config
 from ray_tpu.sched.resources import NodeResourceState, ResourceSpace
 from ray_tpu.sched import bundles as bundles_mod
+from ray_tpu.util.task_events import TaskEventLog
 
 
 class GcsServer:
@@ -46,7 +48,6 @@ class GcsServer:
         self.kv: Dict[str, bytes] = {}
         self.directory: Dict[str, set] = defaultdict(set)  # object_id -> {node_id}
         self.drivers: Dict[int, dict] = {}  # conn_id -> {driver_id}
-        self.task_events: deque = deque(maxlen=100000)
         # GCS-initiated request/response clients to node daemons (the push
         # channel is fire-and-forget; 2PC bundle prepare/commit needs acks —
         # reference: the GCS's raylet clients in gcs_placement_group_scheduler.cc)
@@ -67,8 +68,30 @@ class GcsServer:
         self.persistence_path = persistence_path
         # (pg_id, bundle, node_id) allocations to re-apply as nodes rejoin
         self._pending_bundle_reapply: List[tuple] = []
+        # task-event checkpoint from the previous incarnation's snapshot
+        # (set by _load_tables, consumed by the TaskEventLog below)
+        self._task_events_ckpt: Optional[dict] = None
         if persistence_path:
             self._load_tables()
+
+        # task-event backend (reference: gcs_task_manager.cc): bounded
+        # in-memory window + incremental per-name aggregates + JSONL spill
+        # of the full stream — 1M-task runs keep a queryable timeline.
+        # Constructed AFTER _load_tables so a persistence-backed restart
+        # seeds counters from the checkpoint and replays only the
+        # post-snapshot delta of the spill. Without a persistence path the
+        # log owns an anonymous spill it removes on close; with one, the
+        # spill survives shutdown for post-mortem timeline reads.
+        _spilling = self.config.task_events_spill
+        self.task_events = TaskEventLog(
+            recent_cap=self.config.task_events_recent_cap,
+            spill_path=(
+                persistence_path + ".task_events.jsonl"
+                if _spilling and persistence_path else None
+            ),
+            anonymous_spill=_spilling and not persistence_path,
+            resume=self._task_events_ckpt,
+        )
 
         # --- scheduler state ---
         # intake: raw submissions, vetted once per round by _intake_locked
@@ -126,6 +149,9 @@ class GcsServer:
                     k: {kk: vv for kk, vv in v.items() if kk != "conn"}
                     for k, v in self.actors.items()
                 },
+                # counters + flushed spill offset: makes restart recovery
+                # O(post-snapshot delta) instead of O(full task history)
+                "task_events": self.task_events.snapshot_state(),
             }
 
     def _persist_now(self):
@@ -157,6 +183,7 @@ class GcsServer:
         self.kv = snap.get("kv", {})
         self.jobs = snap.get("jobs", {})
         self.placement_groups = snap.get("placement_groups", {})
+        self._task_events_ckpt = snap.get("task_events")
         # actors come back location-known but unconfirmed; a node re-sync
         # (rpc_node_sync) flips them ALIVE again (reference: GCS restart +
         # raylet reconnect rebuilds the actor table)
@@ -832,9 +859,23 @@ class GcsServer:
                     agg[k] += v
             return dict(agg)
 
+    # server-side response bound (the old in-memory deque's size): a huge
+    # client limit must not materialize a 1M-event spill in GCS memory —
+    # full-history consumers use summarize_tasks or the spill file itself
+    MAX_LIST_TASKS = 100_000
+
     def rpc_list_tasks(self, p, conn):
-        with self._lock:
-            return list(self.task_events)[-int(p.get("limit", 1000)):]
+        # TaskEventLog is internally locked; a large tail may hit the spill
+        # file, so don't hold the GCS lock across it
+        limit = min(int(p.get("limit", 1000)), self.MAX_LIST_TASKS)
+        return self.task_events.tail(limit)
+
+    def rpc_summarize_tasks(self, p, conn):
+        """Exact per-name/status counts over the FULL history — served from
+        incremental aggregates, not by listing events (reference:
+        gcs_task_manager.cc task summary)."""
+        total, by_name = self.task_events.stats()
+        return {"total": total, "by_name": by_name}
 
     def rpc_list_actors(self, p, conn):
         with self._lock:
@@ -1699,5 +1740,8 @@ class GcsServer:
                 self._persist_now()
             except Exception:
                 pass
+        # anonymous (non-persistent) spill files die with the server;
+        # persistence-backed ones survive for post-mortem timeline reads
+        self.task_events.close()
         self._kick()
         self.server.stop()

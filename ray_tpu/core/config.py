@@ -57,6 +57,12 @@ _DEFS: Dict[str, tuple] = {
     "metrics_report_interval_ms": (float, 2000.0),
     "log_to_driver": (bool, True),
     "session_dir_root": (str, "/tmp/ray_tpu"),
+    # task-event log (reference: gcs_task_manager.cc
+    # RAY_task_events_max_num_task_in_gcs): recent window kept in memory;
+    # everything beyond it aggregates + spills to JSONL so 1M-task runs
+    # keep a queryable timeline without unbounded RSS
+    "task_events_recent_cap": (int, 10_000),
+    "task_events_spill": (bool, True),
 }
 
 

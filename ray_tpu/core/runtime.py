@@ -37,6 +37,7 @@ from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.task_spec import TaskSpec, new_id
 from ray_tpu.sched.policy import make_policy_from_config
 from ray_tpu.sched.resources import NodeResourceState, ResourceSpace
+from ray_tpu.util.task_events import TaskEventLog
 
 _context = threading.local()
 
@@ -84,7 +85,14 @@ class LocalRuntime:
         self._running: Dict[str, TaskSpec] = {}
         self._actors: Dict[str, _ActorState] = {}
         self._pgs: Dict[str, dict] = {}
-        self._task_events: List[dict] = []  # timeline (ray timeline equivalent)
+        # timeline (ray timeline equivalent): same bounded-memory backend
+        # as the GCS — recent window + incremental aggregates + anonymous
+        # JSONL spill (removed on shutdown) so 1M-task local runs keep a
+        # full queryable timeline without unbounded RSS
+        self._task_events = TaskEventLog(
+            recent_cap=self.config.task_events_recent_cap,
+            anonymous_spill=self.config.task_events_spill,
+        )
         # internal KV (reference: GCS internal kv, _internal_kv_put — backs
         # named actors, collective group rendezvous, serve state)
         self._kv: Dict[str, bytes] = {}
@@ -672,13 +680,19 @@ class LocalRuntime:
         ]
 
     def timeline(self) -> List[dict]:
-        return list(self._task_events)
+        # full history from the spill stream (the in-memory window alone
+        # would truncate long runs' timelines)
+        return list(self._task_events.scan())
 
     # -------------------------------------------------- state API (local)
     # reference: python/ray/util/state served from GCS task events
 
     def list_tasks(self, limit: int = 1000) -> List[dict]:
-        return list(self._task_events)[-limit:]
+        return self._task_events.tail(limit)
+
+    def summarize_tasks(self) -> dict:
+        total, by_name = self._task_events.stats()
+        return {"total": total, "by_name": by_name}
 
     def list_actors(self) -> List[dict]:
         out = []
@@ -724,6 +738,7 @@ class LocalRuntime:
 
     def shutdown(self):
         self._stopped = True
+        self._task_events.close()
         self._kick()
         for st in list(self._actors.values()):
             with st.cv:

@@ -2,7 +2,6 @@
 
 from __future__ import annotations
 
-from collections import defaultdict
 from typing import Dict, List, Optional
 
 from ray_tpu.core import api as _api
@@ -64,10 +63,7 @@ def summary() -> dict:
 
 
 def summarize_tasks() -> Dict[str, dict]:
-    """Per-task-name counts by status (reference: `ray summary tasks`)."""
-    agg: Dict[str, dict] = defaultdict(lambda: defaultdict(int))
-    for t in _rt().list_tasks(100000):
-        name = t.get("name") or "unknown"
-        agg[name][t.get("status", "UNKNOWN")] += 1
-        agg[name]["total"] += 1
-    return {k: dict(v) for k, v in agg.items()}
+    """Per-task-name counts by status (reference: `ray summary tasks`).
+    Served from the runtime's incremental aggregates — exact over the full
+    history even past the in-memory event window."""
+    return _rt().summarize_tasks()["by_name"]

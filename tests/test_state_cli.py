@@ -134,3 +134,145 @@ def test_list_cluster_events_cluster_mode():
     finally:
         ray_tpu.shutdown()
         c.shutdown()
+
+
+def test_task_event_log_1m_events(tmp_path):
+    """Scale guard (reference: gcs_task_manager.cc bounded task-event
+    backend): 1M events must keep memory bounded at the recent window,
+    keep EXACT full-history aggregates, and keep the complete timeline
+    queryable from the JSONL spill."""
+    from ray_tpu.util.task_events import TaskEventLog
+
+    spill = str(tmp_path / "events.jsonl")
+    log = TaskEventLog(recent_cap=10_000, spill_path=spill)
+    N = 1_000_000
+    statuses = ("FINISHED", "FAILED")
+    for i in range(N):
+        log.append({"task_id": f"t{i}", "name": f"fn{i % 3}",
+                    "status": statuses[i % 10 == 9], "start": float(i),
+                    "end": float(i) + 0.5})
+    assert len(log) == N
+    # memory bound: the deque holds only the window
+    assert len(log._recent) == 10_000
+
+    # aggregates are exact over the full history
+    s = log.summary()
+    assert sum(v["total"] for v in s.values()) == N
+    assert s["fn0"]["total"] == N // 3 + (N % 3 > 0)
+    assert sum(v.get("FAILED", 0) for v in s.values()) == N // 10
+
+    # small tail from memory
+    t = log.tail(5)
+    assert [e["task_id"] for e in t] == [f"t{i}" for i in range(N - 5, N)]
+    # big tail (beyond the window) from the spill file
+    t = log.tail(50_000)
+    assert len(t) == 50_000
+    assert t[0]["task_id"] == f"t{N - 50_000}"
+    assert t[-1]["task_id"] == f"t{N - 1}"
+
+    # full-history scan with a filter
+    n_fail_fn1 = sum(
+        1 for _ in log.scan({"name": "fn1", "status": "FAILED"})
+    )
+    assert n_fail_fn1 == sum(
+        1 for i in range(N) if i % 3 == 1 and i % 10 == 9
+    )
+    log.close(remove_spill=True)
+    assert not os.path.exists(spill)
+
+
+def test_gcs_task_events_window_and_summary():
+    """Cluster-mode state API stays correct past the in-memory window:
+    drive more task results than task_events_recent_cap through a live
+    GCS and check list_tasks tail + exact summarize_tasks."""
+    from ray_tpu.core.config import Config
+    from ray_tpu.cluster.gcs import GcsServer
+    from ray_tpu.cluster.testing import park_scheduler_loop
+
+    gcs = GcsServer(config=Config({"task_events_recent_cap": 50}))
+    park_scheduler_loop(gcs)
+    try:
+        for i in range(300):
+            gcs.task_events.append({
+                "task_id": f"t{i}", "node_id": "n0",
+                "status": "FINISHED" if i % 2 else "FAILED",
+                "name": "w", "start": float(i), "end": float(i) + 1.0,
+                "actor_id": None,
+            })
+        tail = gcs.rpc_list_tasks({"limit": 10}, None)
+        assert [t["task_id"] for t in tail] == [f"t{i}" for i in range(290, 300)]
+        # beyond the 50-event window: the spill serves it
+        full = gcs.rpc_list_tasks({"limit": 250}, None)
+        assert len(full) == 250
+        assert full[0]["task_id"] == "t50"
+        s = gcs.rpc_summarize_tasks({}, None)
+        assert s["total"] == 300
+        assert s["by_name"]["w"]["FINISHED"] == 150
+        assert s["by_name"]["w"]["FAILED"] == 150
+        spill = gcs.task_events._spill_path
+        assert spill and os.path.exists(spill)
+    finally:
+        gcs.shutdown()
+    assert not os.path.exists(spill)  # anonymous spill removed on shutdown
+
+
+def test_task_events_survive_gcs_restart(tmp_path):
+    """A persistence-backed GCS restart must keep the task-event backend
+    self-consistent: the new incarnation replays the spill, so summary,
+    total, and big tails agree across the restart boundary."""
+    from ray_tpu.core.config import Config
+    from ray_tpu.cluster.gcs import GcsServer
+    from ray_tpu.cluster.testing import park_scheduler_loop
+
+    pp = str(tmp_path / "gcs.bin")
+    cfg = {"task_events_recent_cap": 50}
+    gcs = GcsServer(config=Config(cfg), persistence_path=pp)
+    park_scheduler_loop(gcs)
+    for i in range(120):
+        gcs.task_events.append({"task_id": f"a{i}", "name": "w",
+                                "status": "FINISHED"})
+    gcs.shutdown()
+
+    gcs2 = GcsServer(config=Config(cfg), persistence_path=pp)
+    park_scheduler_loop(gcs2)
+    try:
+        s = gcs2.rpc_summarize_tasks({}, None)
+        assert s["total"] == 120, s
+        for i in range(30):
+            gcs2.task_events.append({"task_id": f"b{i}", "name": "w",
+                                     "status": "FAILED"})
+        s = gcs2.rpc_summarize_tasks({}, None)
+        assert s["total"] == 150
+        assert s["by_name"]["w"]["FINISHED"] == 120
+        assert s["by_name"]["w"]["FAILED"] == 30
+        t = gcs2.rpc_list_tasks({"limit": 140}, None)
+        assert len(t) == 140
+        assert t[0]["task_id"] == "a10" and t[-1]["task_id"] == "b29"
+    finally:
+        gcs2.shutdown()
+    # persistence-backed spill survives for post-mortem reads
+    assert os.path.exists(pp + ".task_events.jsonl")
+
+
+def test_task_event_spill_torn_line_recovery(tmp_path):
+    """A crash mid-flush leaves a torn trailing line; recovery must
+    truncate it so the file stays parseable for the rest of the run."""
+    from ray_tpu.util.task_events import TaskEventLog
+
+    spill = str(tmp_path / "e.jsonl")
+    log = TaskEventLog(recent_cap=5, spill_path=spill)
+    for i in range(20):
+        log.append({"task_id": f"t{i}", "name": "w", "status": "FINISHED"})
+    log.close()
+    with open(spill, "a") as f:
+        f.write('{"task_id": "t20", "na')  # torn write, no newline
+
+    log2 = TaskEventLog(recent_cap=5, spill_path=spill)
+    assert len(log2) == 20
+    log2.append({"task_id": "t21", "name": "w", "status": "FINISHED"})
+    log2.flush()
+    # every line parseable again, t20 gone, t21 appended cleanly
+    t = log2.tail(21)
+    assert [e["task_id"] for e in t] == [f"t{i}" for i in range(20)] + ["t21"]
+    assert sum(1 for _ in log2.scan()) == 21
+    log2.close()
