@@ -1,19 +1,24 @@
-"""Runtime environments v1: env_vars + working_dir.
+"""Runtime environments: env_vars + working_dir + py_modules + local pip.
 
 Reference: python/ray/_private/runtime_env/ (working_dir.py uploads the
 directory to GCS storage once, content-addressed; workers download and
-extract it into the session dir and chdir; env_vars merge into the worker
-environment). Same shape here: the driver zips working_dir into the GCS KV
-under a content hash, workers extract it to a per-hash cache dir and run the
-task inside it.
+extract it into the session dir and chdir; py_modules.py ships local
+module trees the same way and prepends them to sys.path; pip.py builds a
+per-env package dir; env_vars merge into the worker environment). Same
+shape here: the driver zips working_dir / each py_module into the GCS KV
+under a content hash, workers extract to a per-hash cache dir; `pip`
+installs from a LOCAL wheels directory (--no-index --find-links — this
+environment has zero egress, so PyPI pip/conda stay out of scope) into a
+per-spec target dir prepended to sys.path.
 
 Unknown keys raise loudly — the silently-ignored `runtime_env` option was a
 round-2/3 verdict correctness trap.
 
 Local-mode caveat: LocalRuntime executes tasks on threads in one process, so
-env_vars/cwd are applied process-globally under a lock for the task's
-duration; concurrently running tasks without a runtime_env may observe them.
-Cluster mode applies them in the (per-task / per-actor) worker process.
+env_vars/cwd/sys.path are applied process-globally under a lock for the
+task's duration; concurrently running tasks without a runtime_env may
+observe them. Cluster mode applies them in the (per-task / per-actor)
+worker process.
 """
 
 from __future__ import annotations
@@ -22,13 +27,15 @@ import contextlib
 import hashlib
 import io
 import os
+import sys
 import threading
 import zipfile
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
-_SUPPORTED_KEYS = {"env_vars", "working_dir"}
+_SUPPORTED_KEYS = {"env_vars", "working_dir", "py_modules", "pip"}
 MAX_WORKING_DIR_BYTES = 256 * 1024 * 1024
 KV_PREFIX = "rtenv:wd:"
+PYMOD_KV_PREFIX = "rtenv:pymod:"
 
 # process-global: env/cwd mutation is process-wide state
 _apply_lock = threading.Lock()
@@ -60,6 +67,39 @@ def validate(runtime_env: Optional[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
             raise TypeError("runtime_env['working_dir'] must be a path string")
         if not os.path.isdir(wd):
             raise ValueError(f"runtime_env working_dir {wd!r} is not a directory")
+    mods = runtime_env.get("py_modules")
+    if mods is not None:
+        if not isinstance(mods, (list, tuple)):
+            raise TypeError(
+                "runtime_env['py_modules'] must be a list of paths"
+            )
+        for m in mods:
+            if not isinstance(m, str):
+                raise TypeError(f"py_modules entry {m!r} must be a path string")
+            if not (
+                os.path.isdir(m)
+                or (os.path.isfile(m) and m.endswith(".py"))
+            ):
+                raise ValueError(
+                    f"py_modules entry {m!r} must be a package directory "
+                    "or a .py file"
+                )
+    pip = runtime_env.get("pip")
+    if pip is not None:
+        if (
+            not isinstance(pip, dict)
+            or not isinstance(pip.get("packages"), (list, tuple))
+            or not isinstance(pip.get("wheels_dir"), str)
+        ):
+            raise TypeError(
+                "runtime_env['pip'] must be {'packages': [...], "
+                "'wheels_dir': <local dir>} — zero-egress environments "
+                "install from a local wheels directory, not PyPI"
+            )
+        if not os.path.isdir(pip["wheels_dir"]):
+            raise ValueError(
+                f"pip wheels_dir {pip['wheels_dir']!r} is not a directory"
+            )
     return dict(runtime_env)
 
 
@@ -94,6 +134,87 @@ def package_working_dir(path: str) -> tuple:
     return KV_PREFIX + digest.hexdigest(), buf.getvalue()
 
 
+def package_py_module(path: str) -> tuple:
+    """Zip one py_module (package dir or single .py file) into bytes,
+    content-addressed like working_dir. Entries are prefixed with the
+    module's import name, so the EXTRACTION DIRECTORY itself is the
+    sys.path root (reference: py_modules.py upload_py_modules_if_needed)."""
+    path = path.rstrip("/")
+    buf = io.BytesIO()
+    digest = hashlib.sha1()
+    if os.path.isfile(path):
+        name = os.path.basename(path)
+        with open(path, "rb") as f:
+            content = f.read()
+        digest.update(name.encode())
+        digest.update(b"\0")
+        digest.update(content)
+        with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+            info = zipfile.ZipInfo(name, date_time=(1980, 1, 1, 0, 0, 0))
+            info.compress_type = zipfile.ZIP_DEFLATED
+            zf.writestr(info, content)
+        return PYMOD_KV_PREFIX + digest.hexdigest(), buf.getvalue()
+    base = os.path.basename(path)
+    total = 0
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        for root, dirs, files in os.walk(path):
+            dirs.sort()
+            for fname in sorted(files):
+                full = os.path.join(root, fname)
+                rel = os.path.join(base, os.path.relpath(full, path))
+                with open(full, "rb") as f:
+                    content = f.read()
+                total += len(content)
+                if total > MAX_WORKING_DIR_BYTES:
+                    raise ValueError(
+                        f"py_module {path!r} exceeds "
+                        f"{MAX_WORKING_DIR_BYTES >> 20}MB"
+                    )
+                digest.update(rel.encode())
+                digest.update(b"\0")
+                digest.update(content)
+                info = zipfile.ZipInfo(rel, date_time=(1980, 1, 1, 0, 0, 0))
+                info.compress_type = zipfile.ZIP_DEFLATED
+                zf.writestr(info, content)
+    return PYMOD_KV_PREFIX + digest.hexdigest(), buf.getvalue()
+
+
+def ensure_pip_env(pip_spec: Dict[str, Any], root: str) -> str:
+    """Install the requested packages from a LOCAL wheels directory into a
+    per-spec target dir (once, cached by spec hash) and return it for
+    sys.path. ``pip install --no-index --find-links`` keeps this fully
+    offline (reference: pip.py's per-runtime-env virtualenv; a --target
+    dir gives the same isolation for pure-Python deps without venv cost)."""
+    import subprocess
+
+    spec_key = hashlib.sha1(
+        repr((sorted(pip_spec["packages"]),
+              os.path.realpath(pip_spec["wheels_dir"]))).encode()
+    ).hexdigest()
+    dest = os.path.join(root, "runtime_envs", "pip", spec_key)
+    if os.path.isdir(dest):
+        return dest
+    os.makedirs(os.path.dirname(dest), exist_ok=True)
+    tmp = f"{dest}.tmp.{os.getpid()}"
+    cmd = [
+        sys.executable, "-m", "pip", "install",
+        "--no-index", "--find-links", pip_spec["wheels_dir"],
+        "--target", tmp, "--quiet", *pip_spec["packages"],
+    ]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"runtime_env pip install failed: {proc.stderr.strip()[-2000:]}"
+        )
+    try:
+        os.rename(tmp, dest)
+    except OSError:
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+    return dest
+
+
 def ensure_working_dir(key: str, data: bytes, root: str) -> str:
     """Extract (once, cached by hash) and return the directory path.
     Concurrency-safe: extraction goes to a private temp dir that is
@@ -116,23 +237,45 @@ def ensure_working_dir(key: str, data: bytes, root: str) -> str:
     return dest
 
 
+def local_py_paths(runtime_env: Optional[Dict[str, Any]],
+                   session_root: str) -> Optional[List[str]]:
+    """Local-mode resolution: py_modules already live on this filesystem,
+    so their PARENT dirs go straight onto sys.path (no packaging round
+    trip); pip specs still build their cached target dir."""
+    if not runtime_env:
+        return None
+    paths = []
+    for m in runtime_env.get("py_modules") or ():
+        m = m.rstrip("/")
+        paths.append(os.path.dirname(os.path.realpath(m)))
+    if runtime_env.get("pip"):
+        paths.append(ensure_pip_env(runtime_env["pip"], session_root))
+    return paths or None
+
+
 @contextlib.contextmanager
 def applied(env_vars: Optional[Dict[str, str]] = None,
-            cwd: Optional[str] = None, keep: bool = False):
-    """Apply env_vars/cwd process-wide for the task's duration. keep=True
-    (actor creation) leaves them in place — the dedicated actor worker owns
-    its environment for the actor's lifetime."""
-    if not env_vars and not cwd:
+            cwd: Optional[str] = None, keep: bool = False,
+            py_paths: Optional[List[str]] = None):
+    """Apply env_vars/cwd/sys.path process-wide for the task's duration.
+    keep=True (actor creation) leaves them in place — the dedicated actor
+    worker owns its environment for the actor's lifetime. ``py_paths``
+    (extracted py_modules roots + pip target dirs) are PREPENDED so they
+    shadow same-named modules on the base path."""
+    if not env_vars and not cwd and not py_paths:
         yield
         return
     _apply_lock.acquire()
     saved_env = {k: os.environ.get(k) for k in (env_vars or {})}
     saved_cwd = os.getcwd() if cwd else None
+    added_paths = [p for p in (py_paths or []) if p not in sys.path]
     try:
         for k, v in (env_vars or {}).items():
             os.environ[k] = v
         if cwd:
             os.chdir(cwd)
+        for p in reversed(added_paths):
+            sys.path.insert(0, p)
         yield
     finally:
         if keep:
@@ -146,5 +289,10 @@ def applied(env_vars: Optional[Dict[str, str]] = None,
                         os.environ[k] = old
                 if saved_cwd:
                     os.chdir(saved_cwd)
+                for p in added_paths:
+                    try:
+                        sys.path.remove(p)
+                    except ValueError:
+                        pass
             finally:
                 _apply_lock.release()

@@ -26,8 +26,14 @@ def cluster():
 
 def test_unknown_runtime_env_key_raises():
     with pytest.raises(ValueError, match="unsupported runtime_env keys"):
-        @ray_tpu.remote(runtime_env={"pip": ["requests"]})
+        @ray_tpu.remote(runtime_env={"conda": {"dependencies": ["x"]}})
         def f():
+            return 1
+
+    # pip IS supported, but only in its offline local-wheels form
+    with pytest.raises(TypeError, match="wheels_dir"):
+        @ray_tpu.remote(runtime_env={"pip": ["requests"]})
+        def f2():
             return 1
 
     with pytest.raises(TypeError, match="env_vars"):
@@ -120,3 +126,123 @@ def test_working_dir_upload_deduped(cluster, tmp_path):
     # one content-addressed KV entry for the dir, not one per task
     keys = [k for k in rt.kv_keys("rtenv:wd:")]
     assert len(keys) == 1
+
+
+def _write_module_tree(root, name, value):
+    pkg = root / name
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text(f"MAGIC = {value!r}\n")
+    (pkg / "helper.py").write_text(
+        "from . import MAGIC\n\ndef shout():\n    return MAGIC.upper()\n"
+    )
+    return str(pkg)
+
+
+def test_py_modules_cluster(cluster, tmp_path):
+    """The worker process does NOT have the module on sys.path; the
+    packaged tree must make it importable there (reference:
+    runtime_env/py_modules.py)."""
+    mod = _write_module_tree(tmp_path, "rtenv_probe_pkg", "hello")
+    ray_tpu.init(address=cluster.address)
+
+    # without the runtime_env the import must fail in the worker (run
+    # FIRST: a later import with the env populates the reused worker's
+    # sys.modules cache, as it would in upstream's per-env worker pools)
+    @ray_tpu.remote(max_retries=0)
+    def no_env():
+        import rtenv_probe_pkg  # noqa: F401
+        return "imported"
+
+    with pytest.raises(Exception, match="rtenv_probe_pkg"):
+        ray_tpu.get(no_env.remote(), timeout=60)
+
+    @ray_tpu.remote(runtime_env={"py_modules": [mod]})
+    def use_it():
+        from rtenv_probe_pkg.helper import shout
+        out = shout()
+        # the import must have come from the extracted cache, not the
+        # driver's tmp_path (the worker can't see the driver's cwd)
+        import rtenv_probe_pkg
+        return out, rtenv_probe_pkg.__file__
+
+    out, path = ray_tpu.get(use_it.remote(), timeout=60)
+    assert out == "HELLO"
+    assert "runtime_envs" in path
+
+
+def test_py_modules_single_file_local(tmp_path):
+    (tmp_path / "solo_mod_probe.py").write_text("ANSWER = 42\n")
+    ray_tpu.init(num_cpus=2)
+    try:
+        @ray_tpu.remote(
+            runtime_env={"py_modules": [str(tmp_path / "solo_mod_probe.py")]}
+        )
+        def use_it():
+            import solo_mod_probe
+            return solo_mod_probe.ANSWER
+
+        assert ray_tpu.get(use_it.remote()) == 42
+    finally:
+        ray_tpu.shutdown()
+
+
+def _build_wheel(wheels_dir, name="tinywheel", version="0.1"):
+    """Hand-assemble a minimal valid wheel (zero egress: no pip wheel /
+    network). A wheel is a zip with the package + .dist-info."""
+    import base64
+    import hashlib
+    import zipfile
+
+    wheels_dir.mkdir(exist_ok=True)
+    whl = wheels_dir / f"{name}-{version}-py3-none-any.whl"
+    di = f"{name}-{version}.dist-info"
+    files = {
+        f"{name}/__init__.py": b"WHEEL_VALUE = 'from-the-wheel'\n",
+        f"{di}/METADATA": (
+            f"Metadata-Version: 2.1\nName: {name}\nVersion: {version}\n"
+        ).encode(),
+        f"{di}/WHEEL": (
+            "Wheel-Version: 1.0\nGenerator: test\nRoot-Is-Purelib: true\n"
+            "Tag: py3-none-any\n"
+        ).encode(),
+    }
+    record_lines = []
+    for path, content in files.items():
+        h = base64.urlsafe_b64encode(
+            hashlib.sha256(content).digest()
+        ).rstrip(b"=").decode()
+        record_lines.append(f"{path},sha256={h},{len(content)}")
+    record_lines.append(f"{di}/RECORD,,")
+    files[f"{di}/RECORD"] = "\n".join(record_lines).encode() + b"\n"
+    with zipfile.ZipFile(whl, "w") as zf:
+        for path, content in files.items():
+            zf.writestr(path, content)
+    return name
+
+
+def test_pip_local_wheels(cluster, tmp_path):
+    """pip from a LOCAL wheels dir (--no-index): the worker imports a
+    package installed into the per-spec target dir (reference:
+    runtime_env/pip.py, offline variant)."""
+    name = _build_wheel(tmp_path / "wheels")
+    ray_tpu.init(address=cluster.address)
+
+    @ray_tpu.remote(runtime_env={
+        "pip": {"packages": [name], "wheels_dir": str(tmp_path / "wheels")},
+    })
+    def use_wheel():
+        import tinywheel
+        return tinywheel.WHEEL_VALUE
+
+    assert ray_tpu.get(use_wheel.remote(), timeout=120) == "from-the-wheel"
+
+
+def test_pip_spec_validation():
+    ray_tpu.init(num_cpus=1)
+    try:
+        with pytest.raises(TypeError, match="wheels_dir"):
+            @ray_tpu.remote(runtime_env={"pip": ["numpy"]})
+            def f():
+                pass
+    finally:
+        ray_tpu.shutdown()
