@@ -115,10 +115,12 @@ class ClusterClient:
         self._daemon_conns: Dict[str, RpcClient] = {}
         self._shm_conns: Dict[str, Any] = {}  # node_id -> ShmClientStore|False
         self._reconstructing: set = set()  # producer task_ids being re-run
-        # working-dir packaging memo: realpath -> KV key (one zip + upload
-        # per directory per driver; mutating the dir mid-run is not picked
-        # up, matching the reference's upload-once semantics)
-        self._uploaded_rtenvs: Dict[str, str] = {}
+        # packaging memo: (kind, realpath) -> KV key (one zip + upload per
+        # directory per driver; mutating the dir mid-run is not picked up,
+        # matching the reference's upload-once semantics). kind matters:
+        # the same tree zips with different layouts as working_dir vs
+        # py_module.
+        self._uploaded_rtenvs: Dict[tuple, str] = {}
         # ---- distributed reference counting (owner side) ----
         # Semantics from reference_count.cc (owned refs, task-duration arg
         # pins, lineage pinned while outputs live, BORROWS), not its
@@ -166,6 +168,7 @@ class ClusterClient:
         self._gcs_host, self._gcs_port = host, port
         self._closed = False
         self.gcs.subscribe("task_result", self._on_task_result)
+        self.gcs.subscribe("stream_item", self._on_stream_item)
         self.gcs.subscribe("actor_update", self._on_actor_update)
         self.gcs.subscribe("nodes", self._on_nodes)
         self.gcs.subscribe("borrow_added", self._on_borrow_added)
@@ -392,6 +395,7 @@ class ClusterClient:
             try:
                 gcs = RpcClient(self._gcs_host, self._gcs_port)
                 gcs.subscribe("task_result", self._on_task_result)
+                gcs.subscribe("stream_item", self._on_stream_item)
                 gcs.subscribe("actor_update", self._on_actor_update)
                 gcs.subscribe("nodes", self._on_nodes)
                 gcs.subscribe("borrow_added", self._on_borrow_added)
@@ -451,6 +455,15 @@ class ClusterClient:
             for i in range(spec.num_returns)
         ]
         if spec.actor_id is not None and not spec.actor_creation:
+            if spec.streaming:
+                # actor-call results ride the per-actor request/response
+                # channel, which has no mid-task push path; streamed actor
+                # methods are local-mode-only for now
+                raise NotImplementedError(
+                    "num_returns='streaming' on actor methods is not "
+                    "supported in cluster mode yet (plain streaming tasks "
+                    "are)"
+                )
             meta = self._make_meta(spec)
             with self._lock:
                 self._inflight_outputs.update(r.id for r in refs)
@@ -630,6 +643,8 @@ class ClusterClient:
             "deps": deps,
             "spec_bytes": spec_bytes,
             "num_returns": spec.num_returns,
+            "streaming": spec.streaming,
+            "backpressure": spec.backpressure,
             "owner": self.worker_id,
             "actor_id": spec.actor_id,
             "actor_creation": spec.actor_creation,
@@ -661,13 +676,37 @@ class ClusterClient:
         if wd:
             import os as _os
 
-            real = _os.path.realpath(wd)
-            key = self._uploaded_rtenvs.get(real)
+            ck = ("wd", _os.path.realpath(wd))
+            key = self._uploaded_rtenvs.get(ck)
             if key is None:
                 key, data = rtenv.package_working_dir(wd)
                 self.kv_put(key, data)
-                self._uploaded_rtenvs[real] = key
+                self._uploaded_rtenvs[ck] = key
             out["working_dir_key"] = key
+        mods = runtime_env.get("py_modules")
+        if mods:
+            import os as _os
+
+            keys = []
+            for m in mods:
+                # cache key carries the packaging KIND: the same directory
+                # zips with different layouts as working_dir vs py_module
+                ck = ("pymod", _os.path.realpath(m))
+                key = self._uploaded_rtenvs.get(ck)
+                if key is None:
+                    key, data = rtenv.package_py_module(m)
+                    self.kv_put(key, data)
+                    self._uploaded_rtenvs[ck] = key
+                keys.append(key)
+            out["py_modules_keys"] = keys
+        if runtime_env.get("pip"):
+            # wheels_dir must be reachable from the workers (same host or
+            # shared storage — the reference makes the same assumption for
+            # local py_modules/pip sources)
+            out["pip"] = {
+                "packages": list(runtime_env["pip"]["packages"]),
+                "wheels_dir": runtime_env["pip"]["wheels_dir"],
+            }
         return out or None
 
     # ------------------------------------------------------------ actor path
@@ -827,6 +866,64 @@ class ClusterClient:
         return True
 
     # ------------------------------------------------------------- results
+
+    # --- streaming generators (protocol: core/generator.py; the consumer
+    # half — ObjectRefGenerator calls these runtime hooks) ---
+
+    def _on_stream_item(self, p: dict):
+        """GCS push: a streaming task yielded an item. Small items arrive
+        inline; big ones land as a __remote__ placeholder the normal get
+        path fetches lazily. The store put wakes any parked generator."""
+        ref = ObjectRef(p["object_id"], owner=self.worker_id)
+        inline = p.get("inline")
+        if inline is not None:
+            rec = serialization.unpack(inline)
+            self.store.put(ref, rec["v"], is_exception=rec["e"])
+        else:
+            self.store.put(
+                ref, ("__remote__", p["node_id"]), is_exception=False
+            )
+
+    def stream_item_ready(self, ref: ObjectRef) -> bool:
+        return self.store.contains(ref)
+
+    def stream_locate(self, ref: ObjectRef) -> bool:
+        """Was this stream item actually produced? (GCS directory check —
+        authoritative even when the push announcement was lost.)"""
+        try:
+            loc = self.gcs.call("locate_object", {"object_id": ref.id})
+        except Exception:  # noqa: BLE001 - GCS mid-restart
+            return False
+        return bool(loc.get("nodes"))
+
+    def stream_mark_remote(self, ref: ObjectRef) -> None:
+        """Pull-through for a stream item whose push announcement was
+        lost: a __remote__ placeholder makes get() fetch it by its GCS
+        directory location (recorded server-side when the item was
+        published, independent of the push)."""
+        if not self.store.contains(ref):
+            self.store.put(ref, ("__remote__", None), is_exception=False)
+
+    def stream_read_end(self, ref: ObjectRef):
+        """(value, is_exception) of the end marker, without raising task
+        errors (they become the stream's final element)."""
+        try:
+            return self._get_one(ref, deadline=time.time() + 30.0), False
+        except GetTimeoutError:
+            raise
+        except BaseException as e:  # noqa: BLE001 - the error IS the value
+            return e, True
+
+    def stream_wait_any(self, refs, timeout: float) -> None:
+        self.store.wait(refs, 1, timeout)
+
+    def stream_ack(self, task_id: str, consumed: int) -> None:
+        try:
+            self.gcs.call_async(
+                "stream_ack", {"task_id": task_id, "consumed": consumed}
+            )
+        except Exception:  # noqa: BLE001 - ack loss only delays the window
+            pass
 
     def _on_task_result(self, p: dict):
         task_id = p["task_id"]

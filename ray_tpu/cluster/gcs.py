@@ -149,16 +149,22 @@ class GcsServer:
                     k: {kk: vv for kk, vv in v.items() if kk != "conn"}
                     for k, v in self.actors.items()
                 },
-                # counters + flushed spill offset: makes restart recovery
-                # O(post-snapshot delta) instead of O(full task history)
-                "task_events": self.task_events.snapshot_state(),
             }
 
     def _persist_now(self):
         import os
         import pickle
 
+        # task-event checkpoint (counters + flushed spill offset: makes
+        # restart recovery O(post-snapshot delta) instead of O(full task
+        # history)) is taken OUTSIDE the GCS lock — snapshot_state flushes
+        # the spill to disk, and blocking every RPC handler on that write
+        # each persist tick is not acceptable. The log has its own lock;
+        # events appended between this line and the table snapshot are
+        # simply replayed as delta on recovery.
+        te_snap = self.task_events.snapshot_state()
         snap = self._snapshot_tables()
+        snap["task_events"] = te_snap
         tmp = self.persistence_path + ".tmp"
         with open(tmp, "wb") as f:
             pickle.dump(snap, f)
@@ -704,6 +710,48 @@ class GcsServer:
             if d.get("driver_id") == driver_id:
                 return d["conn"]
         return None
+
+    # --- streaming generators (reference: _raylet.pyx streaming returns;
+    # protocol in core/generator.py — the GCS relays item announcements
+    # producer->owner and backpressure acks owner->producer) ---
+
+    def rpc_stream_item(self, p, conn):
+        """A streaming task yielded an item: record its location and tell
+        the owner (inline payload rides along for small items, so the
+        driver needs no fetch round trip)."""
+        with self._lock:
+            self.directory[p["object_id"]].add(p["node_id"])
+            ready = self._on_object_added(p["object_id"])
+            info = self.running.get(p["task_id"])
+            owner = self._driver_conn(info["owner_conn"]) if info else None
+        if ready:
+            self._kick()
+        if owner is not None:
+            self._push_conn(owner, "stream_item", {
+                "object_id": p["object_id"],
+                "node_id": p["node_id"],
+                "inline": p.get("inline"),
+            })
+        return {"ok": True}
+
+    def rpc_stream_ack(self, p, conn):
+        """Owner consumed stream items: widen the producer's backpressure
+        window (routed to the daemon hosting the task, which pushes to
+        the worker)."""
+        with self._lock:
+            info = self.running.get(p["task_id"])
+            node_id = info.get("node_id") if info else None
+        if node_id is not None:
+            c = self._daemon_client(node_id)
+            if c is not None:
+                try:
+                    c.notify("stream_ack", {
+                        "task_id": p["task_id"],
+                        "consumed": int(p["consumed"]),
+                    })
+                except Exception:  # noqa: BLE001 - daemon racing death
+                    pass
+        return {"ok": True}
 
     def _push_conn(self, conn, channel, payload):
         self.server.call_soon(

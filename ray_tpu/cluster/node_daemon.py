@@ -538,6 +538,55 @@ class NodeDaemon:
         self._pump()
         return {"ok": True}
 
+    def rpc_stream_item(self, p, conn):
+        """Worker -> daemon: a streaming task yielded an item. Store the
+        payload (shm items were already sealed by the worker), then relay
+        the announcement to the GCS, which records the location and pushes
+        it to the owner. Small payloads ride inline all the way to the
+        driver (reference: small-return inlining)."""
+        payload = p.get("payload")
+        if payload is not None:
+            self.store.put(p["object_id"], payload)
+        elif hasattr(self.store, "note"):
+            self.store.note(p["object_id"])
+        inline = None
+        if (
+            payload is not None
+            and len(payload) <= self.config.max_direct_call_object_size
+        ):
+            inline = payload
+        try:
+            self.gcs.call_async("stream_item", {
+                "task_id": p["task_id"],
+                "object_id": p["object_id"],
+                "node_id": self.node_id,
+                "inline": inline,
+            }).add_done_callback(log_rpc_failure)
+        except Exception:
+            traceback.print_exc()
+        return {"ok": True}
+
+    def rpc_stream_ack(self, p, conn):
+        """GCS -> daemon: forward a consumer ack to the worker running the
+        streaming task so its backpressure window widens."""
+        tid = p["task_id"]
+        w = None
+        with self._lock:
+            for ww in self.workers.values():
+                ct = ww.current_task
+                if ct is not None and ct.get("task_id") == tid:
+                    w = ww
+                    break
+        if w is not None and w.conn is not None:
+            self.server.call_soon(
+                lambda c=w.conn: asyncio.ensure_future(
+                    c.push("stream_ack", {
+                        "task_id": tid, "consumed": int(p["consumed"]),
+                    })
+                )
+            )
+        return {"ok": True}
+
     def rpc_get_object(self, p, conn):
         """Workers/drivers resolve objects through the daemon: local store
         hit, else locate via GCS directory + pull from the peer daemon

@@ -24,7 +24,60 @@ from ray_tpu.cluster.rpc import RpcClient
 
 _actor_instances = {}
 _actor_concurrency = {}
+_actor_aio = {}  # actor_id -> ActorEventLoop for async (coroutine) actors
 _shm = None  # ShmClientStore when the daemon exposes a segment
+
+# streaming-generator backpressure (reference: _raylet.pyx streaming
+# generators): consumer acks arrive as daemon pushes; the producing
+# thread parks here when produced - acked >= the window
+_stream_acks: dict = {}
+_stream_cv = threading.Condition()
+
+
+def _on_stream_ack(p: dict):
+    with _stream_cv:
+        tid = p["task_id"]
+        # only update REGISTERED streams: a straggler ack arriving after
+        # the producer finished must not re-insert the entry (a slow leak
+        # in long-lived pooled/actor workers)
+        if tid in _stream_acks:
+            _stream_acks[tid] = max(_stream_acks[tid], int(p["consumed"]))
+            _stream_cv.notify_all()
+
+
+def _drain_stream(client: RpcClient, t: dict, gen) -> int:
+    """Producer loop for a streaming task: publish each yielded item as
+    produced (shm seal + announcement, or payload in the announcement),
+    parking when the backpressure window fills. Returns the item count —
+    the task's declared return, which doubles as the end-of-stream
+    marker (protocol: core/generator.py)."""
+    task_id = t["task_id"]
+    bp = int(t.get("backpressure") or 0)
+    if bp > 0:
+        with _stream_cv:
+            _stream_acks.setdefault(task_id, 0)
+    n = 0
+    try:
+        for item in gen:
+            oid = ObjectRef.for_task_output(task_id, n + 1).id
+            data = _pack_value(item)
+            msg = {"task_id": task_id, "object_id": oid, "size": len(data)}
+            if not (
+                _shm is not None
+                and _shm.put_with_make_room(oid, data, client)
+            ):
+                msg["payload"] = data
+            client.call("stream_item", msg, timeout=60.0)
+            n += 1
+            if bp > 0:
+                with _stream_cv:
+                    while n - _stream_acks.get(task_id, 0) >= bp:
+                        _stream_cv.wait(timeout=0.5)
+    finally:
+        with _stream_cv:
+            _stream_acks.pop(task_id, None)
+    return n
+
 
 # ---- borrower accounting (reference: reference_count.cc AddBorrowedObject) --
 # Every ObjectRef deserialized out of task args is counted here. A ref still
@@ -135,23 +188,36 @@ from ray_tpu.core import runtime_env as _rtenv_mod  # noqa: E402
 
 
 def _resolve_runtime_env(rtenv):
-    """Materialize a wire-form runtime_env: fetch + extract the working dir
-    (content-hash cached) via this worker's runtime KV client."""
+    """Materialize a wire-form runtime_env: fetch + extract the working
+    dir and py_modules (content-hash cached), build the pip target dir
+    from the local wheels directory. Returns (env_vars, cwd, py_paths)."""
     if not rtenv:
-        return None, None
+        return None, None, None
+    from ray_tpu.core import api as _api
+
+    rt = _api._runtime
     cwd = None
     key = rtenv.get("working_dir_key")
     if key:
-        from ray_tpu.core import api as _api
-
-        rt = _api._runtime
         data = rt.kv_get(key)
         if data is None:
             raise RuntimeError(f"runtime_env working_dir {key} missing from KV")
         cwd = _rtenv_mod.ensure_working_dir(
             key, data, rt.config.session_dir_root
         )
-    return rtenv.get("env_vars"), cwd
+    py_paths = []
+    for mkey in rtenv.get("py_modules_keys") or ():
+        data = rt.kv_get(mkey)
+        if data is None:
+            raise RuntimeError(f"runtime_env py_module {mkey} missing from KV")
+        py_paths.append(_rtenv_mod.ensure_working_dir(
+            mkey, data, rt.config.session_dir_root
+        ))
+    if rtenv.get("pip"):
+        py_paths.append(_rtenv_mod.ensure_pip_env(
+            rtenv["pip"], rt.config.session_dir_root
+        ))
+    return rtenv.get("env_vars"), cwd, py_paths or None
 
 
 # deserialized-function cache (driver side pickles each function once; the
@@ -182,6 +248,28 @@ def _load_func(func_b: bytes, saw_ref) -> object:
             _func_cache.pop(next(iter(_func_cache)))
         _func_cache[func_b] = fn
     return fn
+
+
+def _finish_value(client, t, value, num_returns, aio):
+    """Streaming tasks drain their generator (items published as
+    produced; the count becomes the declared return); everything else
+    keeps the plain num_returns contract."""
+    if t.get("streaming"):
+        if hasattr(value, "__anext__"):
+            if aio is None:
+                raise TypeError(
+                    "async generator returned outside an async actor"
+                )
+            from ray_tpu.core.async_actor import agen_to_iter
+
+            value = agen_to_iter(value, aio)
+        if not hasattr(value, "__next__"):
+            raise TypeError(
+                "num_returns='streaming' requires a generator function; "
+                f"got {type(value)}"
+            )
+        return [_drain_stream(client, t, value)]
+    return [value] if num_returns == 1 else list(value)
 
 
 def _execute(client: RpcClient, t: dict):
@@ -219,26 +307,43 @@ def _execute(client: RpcClient, t: dict):
                 k: _resolve(client, v, arg_pins)
                 for k, v in spec["kwargs"].items()
             }
-        env_vars, env_cwd = _resolve_runtime_env(t.get("runtime_env"))
+        env_vars, env_cwd, env_paths = _resolve_runtime_env(
+            t.get("runtime_env")
+        )
         if t.get("actor_creation"):
             # keep=True: the dedicated actor worker owns this env for the
             # actor's lifetime (reference: per-runtime-env worker pools)
-            with _rtenv_mod.applied(env_vars, env_cwd, keep=True):
+            with _rtenv_mod.applied(env_vars, env_cwd, keep=True,
+                                    py_paths=env_paths):
                 cls = spec["func"]
                 _actor_instances[t["actor_id"]] = cls(*args, **kwargs)
             _actor_concurrency[t["actor_id"]] = int(t.get("max_concurrency", 1))
+            # async actor: all its methods run on one dedicated event loop
+            # (reference: python/ray/actor.py async actors); the dispatch
+            # pool threads below act as concurrency slots that bridge into
+            # the loop and carry the blocking result RPC
+            from ray_tpu.core.async_actor import ActorEventLoop, class_is_async
+
+            if class_is_async(cls):
+                _actor_aio[t["actor_id"]] = ActorEventLoop(
+                    name=f"actor-{t['actor_id'][:8]}-aio"
+                )
             values = [t["actor_id"]]
         elif t.get("actor_id"):
             inst = _actor_instances.get(t["actor_id"])
             if inst is None:
                 raise RuntimeError(f"actor {t['actor_id']} not hosted here")
             method = getattr(inst, spec["method_name"])
-            value = method(*args, **kwargs)
-            values = [value] if num_returns == 1 else list(value)
+            aio = _actor_aio.get(t["actor_id"])
+            if aio is not None:
+                value = aio.call(method, args, kwargs)
+            else:
+                value = method(*args, **kwargs)
+            values = _finish_value(client, t, value, num_returns, aio)
         else:
-            with _rtenv_mod.applied(env_vars, env_cwd):
+            with _rtenv_mod.applied(env_vars, env_cwd, py_paths=env_paths):
                 value = spec["func"](*args, **kwargs)
-            values = [value] if num_returns == 1 else list(value)
+                values = _finish_value(client, t, value, num_returns, None)
         if len(values) != num_returns:
             raise ValueError(
                 f"task returned {len(values)} values, expected {num_returns}"
@@ -302,6 +407,7 @@ def main():  # pragma: no cover - runs as a subprocess
     _attach_shm()
     tasks: "queue.Queue[dict]" = queue.Queue()
     client.subscribe("run_task", tasks.put)
+    client.subscribe("stream_ack", _on_stream_ack)
     client.on_close = lambda: os._exit(0)  # daemon gone -> exit
     # Install the cluster runtime NOW (env RAY_TPU_GCS_ADDR -> ClusterClient)
     # rather than relying on lazy auto-init: threaded-actor methods run on

@@ -191,6 +191,9 @@ _VALID_OPTIONS = {
     "num_cpus", "num_gpus", "num_tpus", "resources", "num_returns",
     "max_retries", "max_restarts", "max_concurrency", "name",
     "scheduling_strategy", "memory", "runtime_env", "lifetime",
+    # streaming generators: bound on unacked in-flight yielded objects
+    # (reference: _raylet.pyx _generator_backpressure_num_objects)
+    "_backpressure_num_objects",
 }
 
 
@@ -279,7 +282,13 @@ class RemoteFunction:
     def remote(self, *args, **kwargs):
         rt = _get_runtime()
         opts = self._options
-        num_returns = int(opts.get("num_returns", 1))
+        nr = opts.get("num_returns", 1)
+        # streaming generator returns (reference: _raylet.pyx
+        # num_returns="streaming"): the caller gets an ObjectRefGenerator
+        # yielding refs as the task produces them; the declared return
+        # slot carries the end-of-stream marker (see core/generator.py)
+        streaming = nr == "streaming"
+        num_returns = 1 if streaming else int(nr)
         max_retries = int(opts.get("max_retries", rt.config.task_max_retries))
         spec = TaskSpec(
             task_id=new_id("task"),
@@ -294,8 +303,16 @@ class RemoteFunction:
             owner_id=rt.worker_id,
             name=opts.get("name") or getattr(self._func, "__name__", "task"),
             runtime_env=opts.get("runtime_env"),
+            streaming=streaming,
+            backpressure=int(opts.get("_backpressure_num_objects", 0)),
         )
         refs = rt.submit_task(spec)
+        if streaming:
+            from ray_tpu.core.generator import ObjectRefGenerator
+
+            return ObjectRefGenerator(
+                spec.task_id, rt.worker_id, ack=spec.backpressure > 0
+            )
         return refs[0] if num_returns == 1 else refs
 
     def __call__(self, *args, **kwargs):
@@ -313,8 +330,9 @@ class ActorMethod:
         self._num_returns = num_returns
 
     def options(self, **opts):
+        nr = opts.get("num_returns", self._num_returns)
         m = ActorMethod(self._handle, self._method_name,
-                        int(opts.get("num_returns", self._num_returns)))
+                        nr if nr == "streaming" else int(nr))
         return m
 
     def remote(self, *args, **kwargs):
@@ -334,14 +352,16 @@ class ActorHandle:
         self._creation_ref = creation_ref
         self._name = name
 
-    def _invoke(self, method_name: str, args, kwargs, num_returns: int):
+    def _invoke(self, method_name: str, args, kwargs, num_returns):
         rt = _get_runtime()
+        streaming = num_returns == "streaming"
+        nr = 1 if streaming else int(num_returns)
         spec = TaskSpec(
             task_id=new_id("atask"),
             func=None,
             args=args,
             kwargs=kwargs,
-            num_returns=num_returns,
+            num_returns=nr,
             resources={},
             max_retries=0,
             retries_left=0,
@@ -349,9 +369,14 @@ class ActorHandle:
             method_name=method_name,
             owner_id=rt.worker_id,
             name=f"{self._actor_id[:12]}.{method_name}",
+            streaming=streaming,
         )
         refs = rt.submit_task(spec)
-        return refs[0] if num_returns == 1 else refs
+        if streaming:
+            from ray_tpu.core.generator import ObjectRefGenerator
+
+            return ObjectRefGenerator(spec.task_id, rt.worker_id)
+        return refs[0] if nr == 1 else refs
 
     def __getattr__(self, name: str):
         if name.startswith("_"):
@@ -390,6 +415,14 @@ class ActorClass:
         rt = _get_runtime()
         opts = self._options
         actor_id = new_id("actor")
+        # Async actors (reference: python/ray/actor.py — a class with any
+        # coroutine method runs its tasks on a per-actor asyncio event
+        # loop). Detection happens here so the default concurrency matches
+        # upstream: async actors admit many in-flight coroutines unless
+        # the user caps them explicitly.
+        from ray_tpu.core.async_actor import class_is_async
+
+        default_mc = 1000 if class_is_async(self._cls) else 1
         spec = TaskSpec(
             task_id=new_id("acreate"),
             func=self._cls,
@@ -403,7 +436,7 @@ class ActorClass:
             actor_id=actor_id,
             actor_creation=True,
             max_restarts=int(opts.get("max_restarts", 0)),
-            max_concurrency=int(opts.get("max_concurrency", 1)),
+            max_concurrency=int(opts.get("max_concurrency", default_mc)),
             owner_id=rt.worker_id,
             name=opts.get("name") or f"{self._cls.__name__}.__init__",
             runtime_env=opts.get("runtime_env"),

@@ -1,0 +1,121 @@
+"""Async (asyncio) actor support: one event loop per actor.
+
+Reference: python/ray/actor.py + src/ray/core_worker async actor support —
+an actor class with any coroutine method runs its tasks on a dedicated
+per-actor asyncio event loop; ``max_concurrency`` bounds the number of
+in-flight coroutines. Coroutines from different calls interleave at await
+points on ONE loop thread, so asyncio primitives (Event, Lock, Condition)
+coordinate naturally across calls — the capability Serve's handle
+composition and the distributed Queue lean on.
+
+Execution model here: dispatch threads (the actor's concurrency slots)
+resolve args and report results — blocking RPC work that must not stall
+the loop — and bridge into the loop only for the user method itself via
+``ActorEventLoop.call``. Sync methods of an async actor also run ON the
+loop (matching upstream: everything the user wrote executes on the loop
+thread, so actor state is never touched from two OS threads at once).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import threading
+from typing import Any, Callable
+
+
+def class_is_async(cls) -> bool:
+    """Upstream detection rule: any coroutine (or async generator) method
+    makes it an async actor (python/ray/actor.py _is_asyncio)."""
+    return any(
+        inspect.iscoroutinefunction(m) or inspect.isasyncgenfunction(m)
+        for _, m in inspect.getmembers(cls, inspect.isfunction)
+    )
+
+
+def agen_to_iter(agen, aio: "ActorEventLoop"):
+    """Bridge an async-generator actor method into a plain iterator:
+    each item is pulled by running __anext__ on the actor's event loop
+    (streamed async-gen methods, reference: _raylet.pyx async streaming
+    generators)."""
+    while True:
+        try:
+            yield aio.call(agen.__anext__, (), {})
+        except StopAsyncIteration:
+            return
+
+
+class ActorEventLoop:
+    """A per-actor asyncio loop on a dedicated daemon thread, with a
+    blocking bridge for the actor's dispatch threads."""
+
+    def __init__(self, name: str):
+        self.loop = asyncio.new_event_loop()
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name=name
+        )
+        self._thread.start()
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+        # Drain before close. Two distinct leftovers exist after stop():
+        # 1) tasks that survived cancellation (caught CancelledError and
+        #    kept awaiting) — gather them;
+        # 2) done-callbacks of tasks that were cancelled DURING shutdown:
+        #    a task's done-callback (which resolves the caller's bridge
+        #    future in run_coroutine_threadsafe's chaining) is call_soon-
+        #    scheduled AFTER the already-queued loop.stop, so it has not
+        #    run yet — closing now would strand every blocked call() in
+        #    fut.result() forever. One sleep(0) cycle flushes them.
+        try:
+            pending = asyncio.all_tasks(self.loop)
+            if pending:
+                self.loop.run_until_complete(
+                    asyncio.gather(*pending, return_exceptions=True)
+                )
+            self.loop.run_until_complete(asyncio.sleep(0))
+        finally:
+            self.loop.close()
+
+    def call(self, method: Callable, args: tuple, kwargs: dict) -> Any:
+        """Run a user method on the loop from a dispatch thread, blocking
+        until it completes. Coroutine methods are awaited; sync methods
+        run inline on the loop thread (briefly blocking other coroutines,
+        as upstream does)."""
+        if self._closed:
+            raise RuntimeError("actor event loop is shut down")
+
+        async def _invoke():
+            r = method(*args, **kwargs)
+            # isawaitable, not iscoroutine: __anext__ of an async
+            # generator returns an async_generator_asend object, which
+            # must be awaited too (streamed async-gen methods)
+            if inspect.isawaitable(r):
+                return await r
+            return r
+
+        fut = asyncio.run_coroutine_threadsafe(_invoke(), self.loop)
+        return fut.result()
+
+    def shutdown(self, join_timeout: float = 2.0):
+        """Cancel every in-flight coroutine and stop the loop. Dispatch
+        threads blocked in call() observe CancelledError on their bridge
+        futures — the actor's death propagates to callers as task
+        errors."""
+        if self._closed:
+            return
+        self._closed = True
+
+        def _cancel_and_stop():
+            for t in asyncio.all_tasks(self.loop):
+                t.cancel()
+            # cancellation resumptions were scheduled first; stop after
+            self.loop.call_soon(self.loop.stop)
+
+        try:
+            self.loop.call_soon_threadsafe(_cancel_and_stop)
+        except RuntimeError:
+            return  # loop already closed
+        self._thread.join(timeout=join_timeout)

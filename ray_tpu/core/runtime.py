@@ -42,6 +42,23 @@ from ray_tpu.util.task_events import TaskEventLog
 _context = threading.local()
 
 
+def _env_stepped(gen, _rtenv, env):
+    """Re-enter the (process-global) runtime env around each production
+    step of a local-mode streaming generator, so the env lock is held
+    only while user code actually runs — never across backpressure
+    parking."""
+    env_vars, cwd, py_paths = env
+    while True:
+        with _rtenv.applied(env_vars, cwd, py_paths=py_paths):
+            try:
+                item = next(gen)
+            except StopIteration:
+                return
+        yield item
+
+
+
+
 class _ActorState:
     def __init__(self, actor_id: str, node_idx: int, demand: np.ndarray):
         self.actor_id = actor_id
@@ -54,6 +71,7 @@ class _ActorState:
         self.death_cause: Optional[str] = None
         self.thread: Optional[threading.Thread] = None
         self.num_restarts = 0
+        self.aio = None  # ActorEventLoop when the class has async methods
 
 
 class LocalRuntime:
@@ -85,6 +103,7 @@ class LocalRuntime:
         self._running: Dict[str, TaskSpec] = {}
         self._actors: Dict[str, _ActorState] = {}
         self._pgs: Dict[str, dict] = {}
+        self._streams: Dict[str, dict] = {}  # task_id -> backpressure state
         # timeline (ray timeline equivalent): same bounded-memory backend
         # as the GCS — recent window + incremental aggregates + anonymous
         # JSONL spill (removed on shutdown) so 1M-task local runs keep a
@@ -306,6 +325,63 @@ class LocalRuntime:
         }
         return args, kwargs
 
+    # ------------------------------------------------- streaming generators
+    # (reference: _raylet.pyx streaming generator returns; protocol in
+    # core/generator.py — items at output indices 1..n, end marker at 0)
+
+    def _drain_stream(self, spec: TaskSpec, gen) -> None:
+        """Producer side: publish each yielded item as it is produced,
+        then the end marker with the final count. A backpressure window
+        parks the generator (not the scheduler) when the consumer lags."""
+        from ray_tpu.core.generator import end_marker_ref, item_ref
+
+        bp = spec.backpressure
+        st = None
+        if bp > 0:
+            st = {"acked": 0, "cv": threading.Condition()}
+            with self._lock:
+                self._streams[spec.task_id] = st
+        n = 0
+        try:
+            for value in gen:  # user errors propagate to _run_task's handler
+                self.put_ref(
+                    item_ref(spec.task_id, n, owner=self.worker_id), value
+                )
+                n += 1
+                if st is not None:
+                    with st["cv"]:
+                        while (
+                            n - st["acked"] >= bp and not self._stopped
+                        ):
+                            st["cv"].wait(timeout=0.5)
+            self.put_ref(
+                end_marker_ref(spec.task_id, owner=self.worker_id), n
+            )
+        finally:
+            if st is not None:
+                with self._lock:
+                    self._streams.pop(spec.task_id, None)
+
+    def stream_ack(self, task_id: str, consumed: int) -> None:
+        """Consumer handed out items [0, consumed): widen the window."""
+        with self._lock:
+            st = self._streams.get(task_id)
+        if st is not None:
+            with st["cv"]:
+                st["acked"] = max(st["acked"], consumed)
+                st["cv"].notify_all()
+
+    def stream_item_ready(self, ref: ObjectRef) -> bool:
+        return self.store.contains(ref)
+
+    def stream_read_end(self, ref: ObjectRef):
+        """(value, is_exception) of the end marker, without raising."""
+        e = self.store.get([ref], timeout=1.0)[0]
+        return e.value, e.is_exception
+
+    def stream_wait_any(self, refs, timeout: float) -> None:
+        self.store.wait(refs, 1, timeout)
+
     def _store_results(self, spec: TaskSpec, value: Any):
         refs = [
             ObjectRef.for_task_output(spec.task_id, i, owner=self.worker_id)
@@ -339,9 +415,30 @@ class LocalRuntime:
             from ray_tpu.core import runtime_env as _rtenv
 
             re = spec.runtime_env or {}
-            with _rtenv.applied(re.get("env_vars"), re.get("working_dir")):
+            env = (
+                re.get("env_vars"), re.get("working_dir"),
+                _rtenv.local_py_paths(re, self.config.session_dir_root),
+            )
+            with _rtenv.applied(env[0], env[1], py_paths=env[2]):
                 value = spec.func(*args, **kwargs)
-            self._store_results(spec, value)
+                if spec.streaming and not hasattr(value, "__next__"):
+                    raise TypeError(
+                        "num_returns='streaming' requires a generator "
+                        f"function; {spec.name} returned {type(value)}"
+                    )
+                if not spec.streaming:
+                    self._store_results(spec, value)
+            if spec.streaming:
+                # drain OUTSIDE the applied() context: it holds the
+                # process-global env lock, and a backpressured stream can
+                # park indefinitely — which would deadlock every other
+                # runtime_env task in local mode. Instead each production
+                # step re-enters the env around next() (user code still
+                # runs under its env; the lock is released while parked).
+                gen = value
+                if any(env):
+                    gen = _env_stepped(value, _rtenv, env)
+                self._drain_stream(spec, gen)
             status = "FINISHED"
         except BaseException as e:
             if spec.retries_left > 0 and not isinstance(e, TaskError):
@@ -434,8 +531,23 @@ class LocalRuntime:
             from ray_tpu.core import runtime_env as _rtenv
 
             re = creation_spec.runtime_env or {}
-            with _rtenv.applied(re.get("env_vars"), re.get("working_dir")):
+            with _rtenv.applied(
+                re.get("env_vars"), re.get("working_dir"),
+                py_paths=_rtenv.local_py_paths(
+                    re, self.config.session_dir_root
+                ),
+            ):
                 st.instance = cls(*args, **kwargs)
+            # async actor: every method (coroutine or sync) runs on this
+            # dedicated per-actor event loop (reference: python/ray/actor.py
+            # async actors); max_concurrency bounds in-flight coroutines
+            # via the semaphore-gated dispatch below
+            from ray_tpu.core.async_actor import ActorEventLoop, class_is_async
+
+            if class_is_async(type(st.instance)):
+                st.aio = ActorEventLoop(
+                    name=f"raytpu-actor-{st.actor_id[:8]}-aio"
+                )
             self._store_results(creation_spec, st.actor_id)
         except BaseException as e:
             tb = traceback.format_exc()
@@ -485,7 +597,10 @@ class LocalRuntime:
                     target=_run, daemon=True,
                     name=f"raytpu-actor-{st.actor_id[:8]}-mc",
                 ).start()
-        # drain mailbox with death errors
+        # drain mailbox with death errors; cancel in-flight coroutines so
+        # dispatch threads blocked on the loop observe the death
+        if st.aio is not None:
+            st.aio.shutdown()
         self._fail_actor(st, creation_spec=None)
         self._release_resources(st.node_idx, st.demand)
 
@@ -495,8 +610,25 @@ class LocalRuntime:
         try:
             args, kwargs = self._resolve_args(spec)
             method = getattr(st.instance, spec.method_name)
-            value = method(*args, **kwargs)
-            self._store_results(spec, value)
+            if st.aio is not None:
+                # async actor: user code runs on the actor's event loop
+                # (this dispatch thread blocks as the concurrency slot)
+                value = st.aio.call(method, args, kwargs)
+            else:
+                value = method(*args, **kwargs)
+            if spec.streaming:
+                if hasattr(value, "__anext__"):
+                    from ray_tpu.core.async_actor import agen_to_iter
+
+                    value = agen_to_iter(value, st.aio)
+                if not hasattr(value, "__next__"):
+                    raise TypeError(
+                        "num_returns='streaming' requires a generator "
+                        f"method; {spec.method_name} returned {type(value)}"
+                    )
+                self._drain_stream(spec, value)
+            else:
+                self._store_results(spec, value)
             status = "FINISHED"
         except BaseException as e:
             tb = traceback.format_exc()

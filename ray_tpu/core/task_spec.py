@@ -53,6 +53,13 @@ class TaskSpec:
     max_concurrency: int = 1
     # per-task environment (validated dict: env_vars / working_dir)
     runtime_env: Optional[Dict[str, Any]] = None
+    # streaming generator returns (reference: _raylet.pyx streaming
+    # generators / num_returns="streaming"): the task's declared return
+    # (output index 0) is the END MARKER — item count on success, the
+    # error on failure — and yielded items stream at indices 1..n as the
+    # task produces them. backpressure>0 bounds unacked in-flight items.
+    streaming: bool = False
+    backpressure: int = 0
     # bookkeeping
     owner_id: Optional[str] = None
     submitted_at: float = field(default_factory=time.time)

@@ -49,6 +49,20 @@ def _partition_block(part_fn, n, idx, block):
     return parts[0] if n == 1 else tuple(parts)
 
 
+@ray_tpu.remote(num_returns="streaming")
+def _partition_block_stream(part_fn, n, idx, block):
+    """Streaming map side of an exchange (reference: the push-based
+    shuffle / streaming-generator exchange in ray.data): each partition
+    is PUBLISHED as it is produced — a separate store object shipped
+    mid-task — instead of all n riding the task's completion as one
+    result set. Partition i of every map task is consumable while the
+    slower maps still run."""
+    if getattr(part_fn, "_wants_index", False):
+        yield from part_fn(block, n, idx)
+    else:
+        yield from part_fn(block, n)
+
+
 @ray_tpu.remote
 def _count_rows(block):
     return BlockAccessor(block).num_rows()
@@ -173,12 +187,31 @@ def _execute_all_to_all(refs: List, stage: _AllToAllStage) -> List:
     part_fn = stage.part_fn
     if stage.prepare is not None:
         part_fn = stage.prepare(refs)
-    parts = [
-        _partition_block.options(num_returns=n).remote(part_fn, n, i, ref)
-        for i, ref in enumerate(refs)
-    ]
     if n == 1:
-        parts = [[p] for p in parts]
+        parts = [
+            [_partition_block.remote(part_fn, n, i, ref)]
+            for i, ref in enumerate(refs)
+        ]
+    else:
+        # streaming exchange: every map task publishes partitions as it
+        # produces them; consuming the generators overlaps partitioning
+        # with transfer across the whole map wave
+        gens = [
+            _partition_block_stream.remote(part_fn, n, i, ref)
+            for i, ref in enumerate(refs)
+        ]
+        parts = [list(g) for g in gens]
+        for i, (g, p) in enumerate(zip(gens, parts)):
+            if g.errored:
+                # the stream's last ref carries the partitioner's real
+                # exception — surface IT, not a block-count mismatch (and
+                # never hand the error marker to a reduce task as data)
+                ray_tpu.get(p[-1])
+            if len(p) != n:
+                raise ValueError(
+                    f"exchange partitioner produced {len(p)} blocks for "
+                    f"input {i}, expected {n}"
+                )
     out = []
     for j in range(n):
         out.append(

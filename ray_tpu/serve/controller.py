@@ -171,6 +171,9 @@ class ServeController:
                                 spec["init_kwargs"],
                                 spec.get("user_config"),
                                 identity=(app_name, name, rid),
+                                max_ongoing_requests=int(
+                                    spec["max_ongoing_requests"]
+                                ),
                             )
                         )
                         st.replica_ids.append(rid)

@@ -3,10 +3,18 @@
 Reference: python/ray/serve/_private/replica.py (UserCallableWrapper /
 RayServeReplica — counts ongoing requests, calls user code, supports
 function and class deployments, reconfigure via user_config).
+
+The replica is an ASYNC actor (handle_request is a coroutine), matching
+the reference's asyncio replica: ``async def`` user handlers interleave
+on the replica's event loop (in-replica concurrency without threads),
+while sync handlers are pushed to the loop's default executor so a
+blocking model call never stalls the loop — the reference's
+run-sync-in-threadpool behavior.
 """
 
 from __future__ import annotations
 
+import asyncio
 import inspect
 import threading
 from typing import Any, Dict, Optional
@@ -19,10 +27,21 @@ class ServeReplica:
     def __init__(self, func_or_class, init_args, init_kwargs,
                  user_config: Optional[Dict] = None,
                  identity: Optional[tuple] = None,
-                 metrics_period_s: float = 0.2):
+                 metrics_period_s: float = 0.2,
+                 max_ongoing_requests: int = 32):
         self._lock = threading.Lock()
         self._ongoing = 0
         self._total = 0
+        # sync handlers run here, NOT on the loop's default executor: the
+        # default caps at min(32, cpus+4) threads, which would silently
+        # cap sync concurrency below max_ongoing_requests (and starve
+        # @serve.batch rendezvous larger than the cap)
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._sync_pool = ThreadPoolExecutor(
+            max_workers=max(int(max_ongoing_requests), 2),
+            thread_name_prefix="serve-sync",
+        )
         if inspect.isclass(func_or_class):
             self._callable = func_or_class(*init_args, **init_kwargs)
             self._is_function = False
@@ -68,7 +87,7 @@ class ServeReplica:
             except Exception:
                 ctrl = None  # controller gone/respawned; re-resolve
 
-    def handle_request(self, method_name: str, args, kwargs):
+    async def handle_request(self, method_name: str, args, kwargs):
         with self._lock:
             self._ongoing += 1
             self._total += 1
@@ -77,7 +96,15 @@ class ServeReplica:
                 target = self._callable
             else:
                 target = getattr(self._callable, method_name or "__call__")
-            return target(*args, **kwargs)
+            if inspect.iscoroutinefunction(target):
+                return await target(*args, **kwargs)
+            # sync handler: off the loop, onto the replica's own pool —
+            # @serve.batch rendezvous and blocking model calls keep their
+            # thread semantics and can overlap with coroutine handlers
+            loop = asyncio.get_running_loop()
+            return await loop.run_in_executor(
+                self._sync_pool, lambda: target(*args, **kwargs)
+            )
         finally:
             with self._lock:
                 self._ongoing -= 1

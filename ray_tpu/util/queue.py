@@ -1,25 +1,29 @@
-"""Distributed FIFO queue backed by an actor.
+"""Distributed FIFO queue backed by an async actor.
 
-Reference: python/ray/util/queue.py (Queue — actor-backed, blocking
-put/get with timeouts, qsize/empty/full).
+Reference: python/ray/util/queue.py (Queue — an asyncio.Queue inside an
+async actor; blocking put/get with timeouts, qsize/empty/full). Same
+design here now that async actors exist: blocking semantics live
+SERVER-side as coroutines parked on an asyncio.Condition, woken by the
+matching put/get instead of the old 10ms client poll loop.
 
-The actor side is strictly NON-blocking (try_put/try_get return
-immediately); blocking semantics live client-side as a poll loop. A
-blocking server method would pin one of the actor's max_concurrency thread
-slots per waiter, and enough blocked getters would starve every putter —
-the classic thread-pool deadlock.
+Capacity note: this runtime's async-actor bridge still pins one dispatch
+thread per IN-FLIGHT call (the coroutines share one loop, but each
+caller's slot blocks on the bridge future), so a parked waiter costs a
+thread up to the actor's max_concurrency (1000 for async actors). To
+keep a fully saturated waiter pool from wedging putters out of the
+dispatch pool forever, clients park in bounded slices: a waiter re-calls
+every few seconds, freeing its slot at each boundary — under saturation
+this degrades to coarse polling instead of deadlock.
 """
 
 from __future__ import annotations
 
-import threading
+import asyncio
 import time
 from collections import deque
 from typing import Any, List, Optional
 
 import ray_tpu
-
-_POLL_S = 0.01
 
 
 class Empty(Exception):
@@ -35,67 +39,128 @@ class _QueueActor:
     def __init__(self, maxsize: int):
         self._max = maxsize
         self._q: deque = deque()
-        self._lock = threading.Lock()
+        self._cv = asyncio.Condition()
 
-    def try_put(self, item) -> bool:
-        with self._lock:
+    async def put(self, item, timeout: Optional[float] = None) -> bool:
+        """Blocking put: parks until space frees or the timeout elapses.
+        Returns False on timeout."""
+        async with self._cv:
             if self._max > 0 and len(self._q) >= self._max:
-                return False
+                try:
+                    await asyncio.wait_for(
+                        self._cv.wait_for(
+                            lambda: len(self._q) < self._max
+                        ),
+                        timeout,
+                    )
+                except asyncio.TimeoutError:
+                    return False
             self._q.append(item)
+            self._cv.notify_all()
             return True
 
-    def try_get(self):
-        with self._lock:
+    async def get(self, timeout: Optional[float] = None):
+        """Blocking get: parks until an item arrives or the timeout
+        elapses. Returns a ("__item__", value) tuple, or ("__empty__",)
+        on timeout (exceptions stay client-side so a timeout isn't a
+        logged actor failure)."""
+        async with self._cv:
             if not self._q:
-                return ("__empty__",)
-            return ("__item__", self._q.popleft())
+                try:
+                    await asyncio.wait_for(
+                        self._cv.wait_for(lambda: bool(self._q)), timeout
+                    )
+                except asyncio.TimeoutError:
+                    return ("__empty__",)
+            item = self._q.popleft()
+            self._cv.notify_all()
+            return ("__item__", item)
+
+    def try_put(self, item) -> bool:
+        if self._max > 0 and len(self._q) >= self._max:
+            return False
+        self._q.append(item)
+        self._notify()
+        return True
+
+    def try_get(self):
+        if not self._q:
+            return ("__empty__",)
+        item = self._q.popleft()
+        self._notify()
+        return ("__item__", item)
+
+    def _notify(self):
+        # sync methods run ON the loop thread (async-actor contract), so
+        # parked coroutines must still be woken after a try_put/try_get
+        async def _kick():
+            async with self._cv:
+                self._cv.notify_all()
+
+        asyncio.get_running_loop().create_task(_kick())
 
     def qsize(self) -> int:
-        with self._lock:
-            return len(self._q)
+        return len(self._q)
 
     def drain(self, max_items: int) -> List[Any]:
-        with self._lock:
-            out = []
-            while self._q and len(out) < max_items:
-                out.append(self._q.popleft())
-            return out
+        out = []
+        while self._q and len(out) < max_items:
+            out.append(self._q.popleft())
+        self._notify()
+        return out
 
 
 class Queue:
     def __init__(self, maxsize: int = 0, actor_options: Optional[dict] = None):
         opts = dict(actor_options or {})
         opts.setdefault("num_cpus", 0)
-        opts.setdefault("max_concurrency", 8)
         self.maxsize = maxsize
         self._actor = _QueueActor.options(**opts).remote(maxsize)
 
+    # server-side parking slice: bounds how long one blocked waiter pins a
+    # dispatch slot (see module docstring)
+    _SLICE_S = 5.0
+
     def put(self, item, block: bool = True, timeout: Optional[float] = None):
-        deadline = None if timeout is None else time.time() + timeout
-        while True:
-            if ray_tpu.get(self._actor.try_put.remote(item)):
-                return
-            if not block or (deadline is not None and time.time() >= deadline):
+        if not block:
+            if not ray_tpu.get(self._actor.try_put.remote(item)):
                 raise Full("queue full")
-            # while full, poll the (tiny) qsize instead of re-shipping the
-            # item payload on every attempt
-            while self.maxsize > 0 and ray_tpu.get(self._actor.qsize.remote()) >= self.maxsize:
-                if deadline is not None and time.time() >= deadline:
-                    raise Full("queue full")
-                time.sleep(_POLL_S)
+            return
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            remaining = (
+                self._SLICE_S if deadline is None
+                else min(self._SLICE_S, deadline - time.monotonic())
+            )
+            if remaining <= 0:
+                raise Full("queue full")
+            if ray_tpu.get(self._actor.put.remote(item, remaining)):
+                return
+            if deadline is not None and time.monotonic() >= deadline:
+                raise Full("queue full")
 
     def put_nowait(self, item):
         self.put(item, block=False)
 
     def get(self, block: bool = True, timeout: Optional[float] = None):
-        deadline = None if timeout is None else time.time() + timeout
-        while True:
+        if not block:
             res = ray_tpu.get(self._actor.try_get.remote())
             if res[0] == "__item__":
                 return res[1]
-            if not block or (deadline is not None and time.time() >= deadline):
+            raise Empty("queue empty")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            remaining = (
+                self._SLICE_S if deadline is None
+                else min(self._SLICE_S, deadline - time.monotonic())
+            )
+            if remaining <= 0:
                 raise Empty("queue empty")
-            time.sleep(_POLL_S)
+            res = ray_tpu.get(self._actor.get.remote(remaining))
+            if res[0] == "__item__":
+                return res[1]
+            if deadline is not None and time.monotonic() >= deadline:
+                raise Empty("queue empty")
 
     def get_nowait(self):
         return self.get(block=False)

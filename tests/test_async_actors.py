@@ -1,0 +1,191 @@
+"""Async (asyncio) actor tests.
+
+Reference: python/ray/tests/test_asyncio.py — a class with any coroutine
+method becomes an async actor: its tasks run as coroutines on ONE
+per-actor event loop, interleaving at await points, with max_concurrency
+bounding in-flight coroutines. These semantics (single loop thread,
+asyncio primitives shared across calls, FIFO start order, cancellation on
+kill) are what Serve's composition and the distributed Queue rely on.
+"""
+
+import asyncio
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def ray4():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_async_method_returns_value(ray4):
+    @ray_tpu.remote
+    class A:
+        async def add(self, x, y):
+            await asyncio.sleep(0.01)
+            return x + y
+
+    a = A.remote()
+    assert ray_tpu.get(a.add.remote(2, 3)) == 5
+    assert ray_tpu.get([a.add.remote(i, i) for i in range(10)]) == [
+        2 * i for i in range(10)
+    ]
+
+
+def test_async_calls_share_one_loop(ray4):
+    """Many calls park on an asyncio.Event created in __init__; a later
+    call sets it and releases them all — only possible if every coroutine
+    runs on the same event loop."""
+
+    @ray_tpu.remote
+    class Gate:
+        def __init__(self):
+            self.ev = asyncio.Event()
+
+        async def wait(self):
+            await self.ev.wait()
+            return "released"
+
+        async def open(self):
+            self.ev.set()
+            return "opened"
+
+    g = Gate.remote()
+    waiters = [g.wait.remote() for _ in range(8)]
+    time.sleep(0.2)  # everyone parked on the event
+    assert ray_tpu.get(g.open.remote()) == "opened"
+    assert ray_tpu.get(waiters, timeout=10) == ["released"] * 8
+
+
+def test_async_concurrency_cap(ray4):
+    """max_concurrency bounds in-flight coroutines."""
+
+    @ray_tpu.remote(max_concurrency=2)
+    class Counted:
+        def __init__(self):
+            self.inflight = 0
+            self.peak = 0
+
+        async def step(self):
+            self.inflight += 1
+            self.peak = max(self.peak, self.inflight)
+            await asyncio.sleep(0.05)
+            self.inflight -= 1
+            return self.peak
+
+    c = Counted.remote()
+    ray_tpu.get([c.step.remote() for _ in range(8)])
+    assert ray_tpu.get(c.step.remote()) <= 2
+
+
+def test_async_fifo_start_order(ray4):
+    """Coroutines begin executing in submission order."""
+
+    @ray_tpu.remote
+    class Ordered:
+        def __init__(self):
+            self.starts = []
+
+        async def go(self, i):
+            self.starts.append(i)
+            await asyncio.sleep(0.001)
+            return i
+
+        async def log(self):
+            return list(self.starts)
+
+    o = Ordered.remote()
+    ray_tpu.get([o.go.remote(i) for i in range(20)])
+    assert ray_tpu.get(o.log.remote()) == list(range(20))
+
+
+def test_sync_method_runs_on_loop_thread(ray4):
+    """Sync methods of an async actor also execute on the loop thread, so
+    actor state is never touched from two OS threads at once."""
+
+    @ray_tpu.remote
+    class Mixed:
+        async def loop_thread(self):
+            return threading.get_ident()
+
+        def sync_thread(self):
+            return threading.get_ident()
+
+    m = Mixed.remote()
+    assert ray_tpu.get(m.loop_thread.remote()) == ray_tpu.get(
+        m.sync_thread.remote()
+    )
+
+
+def test_async_actor_error_propagates(ray4):
+    @ray_tpu.remote
+    class Boom:
+        async def go(self):
+            raise ValueError("async boom")
+
+    b = Boom.remote()
+    with pytest.raises(Exception, match="async boom"):
+        ray_tpu.get(b.go.remote())
+
+
+def test_kill_cancels_parked_coroutines(ray4):
+    """ray.kill on an async actor cancels in-flight coroutines: parked
+    callers see the actor's death instead of hanging forever."""
+
+    @ray_tpu.remote
+    class Stuck:
+        def __init__(self):
+            self.ev = asyncio.Event()
+
+        async def wait(self):
+            await self.ev.wait()
+            return "never"
+
+    s = Stuck.remote()
+    refs = [s.wait.remote() for _ in range(3)]
+    time.sleep(0.2)
+    t0 = time.time()
+    ray_tpu.kill(s)
+    for r in refs:
+        with pytest.raises(Exception, match="Cancelled|dead"):
+            ray_tpu.get(r, timeout=10)
+    # cancellation must be DELIVERED, not discovered via get timeouts
+    assert time.time() - t0 < 5.0
+
+
+def test_async_actor_cluster_mode():
+    """The worker-process path: coroutines share a loop inside a
+    dedicated actor worker on a real (embedded) cluster."""
+    from ray_tpu.cluster.cluster_utils import Cluster
+
+    c = Cluster()
+    c.add_node(num_cpus=2)
+    ray_tpu.init(address=c.address)
+    try:
+        @ray_tpu.remote
+        class Gate:
+            def __init__(self):
+                self.ev = asyncio.Event()
+
+            async def wait(self):
+                await self.ev.wait()
+                return "released"
+
+            async def open(self):
+                self.ev.set()
+                return "opened"
+
+        g = Gate.remote()
+        waiters = [g.wait.remote() for _ in range(4)]
+        time.sleep(0.3)
+        assert ray_tpu.get(g.open.remote(), timeout=30) == "opened"
+        assert ray_tpu.get(waiters, timeout=30) == ["released"] * 4
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()

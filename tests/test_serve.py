@@ -344,3 +344,29 @@ def test_handle_retries_on_crashed_replica_without_rescale(ray8):
         assert h.remote(i).result(timeout=30) == i + 100
         ok += 1
     assert ok == 30
+
+
+def test_async_deployment_in_replica_concurrency(ray8):
+    """Async handlers interleave on the replica's event loop: N requests
+    park on an asyncio.Event inside ONE replica and a later request
+    releases them — impossible without in-replica asyncio concurrency
+    (reference: serve's asyncio replica runtime)."""
+    import asyncio
+
+    @serve.deployment(num_replicas=1, max_ongoing_requests=16)
+    class Gate:
+        def __init__(self):
+            self.ev = asyncio.Event()
+
+        async def __call__(self, cmd):
+            if cmd == "open":
+                self.ev.set()
+                return "opened"
+            await self.ev.wait()
+            return "released"
+
+    h = serve.run(Gate.bind(), route_prefix=None)
+    waiters = [h.remote("wait") for _ in range(5)]
+    time.sleep(0.3)
+    assert h.remote("open").result(timeout=10) == "opened"
+    assert [w.result(timeout=10) for w in waiters] == ["released"] * 5

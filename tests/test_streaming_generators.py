@@ -1,0 +1,235 @@
+"""Streaming generator return tests.
+
+Reference: python/ray/_raylet.pyx streaming generators +
+python/ray/tests/test_streaming_generator.py — num_returns="streaming"
+yields ObjectRefs incrementally as the task produces them, errors arrive
+as the stream's last element, and a backpressure window parks the
+producer when the consumer lags.
+"""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def ray4():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_stream_basic(ray4):
+    @ray_tpu.remote(num_returns="streaming")
+    def gen(n):
+        for i in range(n):
+            yield i * 10
+
+    out = [ray_tpu.get(r) for r in gen.remote(5)]
+    assert out == [0, 10, 20, 30, 40]
+
+
+def test_stream_empty(ray4):
+    @ray_tpu.remote(num_returns="streaming")
+    def gen():
+        if False:
+            yield 1
+
+    assert [ray_tpu.get(r) for r in gen.remote()] == []
+
+
+def test_stream_incremental_delivery(ray4):
+    """Refs arrive BEFORE the task completes: the consumer reads item 0
+    while the producer is still blocked producing item 2."""
+    @ray_tpu.remote(num_returns="streaming")
+    def slow_gen():
+        yield "first"
+        time.sleep(0.5)
+        yield "second"
+        time.sleep(5.0)  # still running when we assert below
+        yield "third"
+
+    g = slow_gen.remote()
+    t0 = time.time()
+    first = ray_tpu.get(next(g))
+    assert first == "first"
+    assert time.time() - t0 < 2.0  # didn't wait for the whole task
+
+
+def test_stream_error_is_last_element(ray4):
+    @ray_tpu.remote(num_returns="streaming", max_retries=0)
+    def bad_gen():
+        yield 1
+        yield 2
+        raise ValueError("stream boom")
+
+    g = bad_gen.remote()
+    assert ray_tpu.get(next(g)) == 1
+    assert ray_tpu.get(next(g)) == 2
+    err_ref = next(g)
+    with pytest.raises(Exception, match="stream boom"):
+        ray_tpu.get(err_ref)
+    with pytest.raises(StopIteration):
+        next(g)
+
+
+def test_stream_backpressure(ray4):
+    """With a window of 2, the producer stalls until the consumer acks:
+    at most window+1 items may ever have been produced beyond the
+    consumed count."""
+    produced = []
+
+    @ray_tpu.remote(num_returns="streaming", _backpressure_num_objects=2)
+    def gen():
+        for i in range(10):
+            produced.append(i)
+            yield i
+
+    g = gen.remote()
+    time.sleep(0.5)  # producer runs ahead only as far as the window
+    assert len(produced) <= 3  # window 2 (+1 in flight at the gate)
+    out = [ray_tpu.get(r) for r in g]
+    assert out == list(range(10))
+    assert len(produced) == 10
+
+
+def test_stream_non_generator_rejected(ray4):
+    @ray_tpu.remote(num_returns="streaming", max_retries=0)
+    def notgen():
+        return 42
+
+    g = notgen.remote()
+    err_ref = next(g)
+    with pytest.raises(Exception, match="generator"):
+        ray_tpu.get(err_ref)
+
+
+def test_stream_actor_method(ray4):
+    @ray_tpu.remote
+    class Producer:
+        def __init__(self):
+            self.base = 100
+
+        def emit(self, n):
+            for i in range(n):
+                yield self.base + i
+
+    p = Producer.remote()
+    out = [ray_tpu.get(r) for r in p.emit.options(
+        num_returns="streaming"
+    ).remote(4)]
+    assert out == [100, 101, 102, 103]
+
+
+def test_stream_async_actor_method(ray4):
+    """Async generator methods stream through the actor's event loop."""
+    import asyncio
+
+    @ray_tpu.remote
+    class AsyncProducer:
+        async def emit(self, n):
+            for i in range(n):
+                await asyncio.sleep(0.001)
+                yield i * 2
+
+    p = AsyncProducer.remote()
+    out = [ray_tpu.get(r) for r in p.emit.options(
+        num_returns="streaming"
+    ).remote(4)]
+    assert out == [0, 2, 4, 6]
+
+
+def test_stream_refs_usable_as_task_args(ray4):
+    """Streamed refs are first-class: passing one to another task
+    resolves through the normal dependency machinery."""
+    @ray_tpu.remote(num_returns="streaming")
+    def gen():
+        yield 7
+        yield 8
+
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
+    g = gen.remote()
+    refs = list(g)
+    assert ray_tpu.get([double.remote(r) for r in refs]) == [14, 16]
+
+
+def test_stream_cluster_mode():
+    """Full cluster path: worker publishes items as produced (GCS relay,
+    inline push to the owner), the driver's generator consumes them
+    before the task completes, and errors arrive as the last element."""
+    from ray_tpu.cluster.cluster_utils import Cluster
+
+    c = Cluster()
+    c.add_node(num_cpus=2)
+    ray_tpu.init(address=c.address)
+    try:
+        @ray_tpu.remote(num_returns="streaming")
+        def gen(n):
+            for i in range(n):
+                yield {"i": i, "pad": "x" * 100}
+
+        out = [ray_tpu.get(r, timeout=30)["i"] for r in gen.remote(6)]
+        assert out == list(range(6))
+
+        # incremental: first item readable while the producer still runs
+        @ray_tpu.remote(num_returns="streaming")
+        def slow():
+            yield "early"
+            time.sleep(8.0)
+            yield "late"
+
+        g = slow.remote()
+        t0 = time.time()
+        assert ray_tpu.get(next(g), timeout=30) == "early"
+        assert time.time() - t0 < 6.0
+
+        # mid-stream error is the last element
+        @ray_tpu.remote(num_returns="streaming", max_retries=0)
+        def bad():
+            yield 1
+            raise RuntimeError("cluster stream boom")
+
+        g = bad.remote()
+        assert ray_tpu.get(next(g), timeout=30) == 1
+        with pytest.raises(Exception, match="cluster stream boom"):
+            ray_tpu.get(next(g), timeout=30)
+        with pytest.raises(StopIteration):
+            next(g)
+
+        # big items take the location/fetch path instead of inline
+        @ray_tpu.remote(num_returns="streaming")
+        def big(n):
+            import numpy as np
+            for i in range(n):
+                yield np.full(300_000, i, dtype=np.int32)  # ~1.2MB
+
+        vals = [ray_tpu.get(r, timeout=60) for r in big.remote(3)]
+        assert [int(v[0]) for v in vals] == [0, 1, 2]
+
+        # backpressure survives the GCS->daemon->worker ack chain
+        @ray_tpu.remote(num_returns="streaming", _backpressure_num_objects=2)
+        def steady(n):
+            for i in range(n):
+                yield i
+
+        out = [ray_tpu.get(r, timeout=30) for r in steady.remote(8)]
+        assert out == list(range(8))
+
+        # actor-method streaming is an explicit, clear error in cluster mode
+        @ray_tpu.remote
+        class P:
+            def emit(self):
+                yield 1
+
+        p = P.remote()
+        with pytest.raises(NotImplementedError, match="streaming"):
+            p.emit.options(num_returns="streaming").remote()
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
