@@ -136,7 +136,10 @@ class Autoscaler:
         self._scale_down(state)
 
     def _scale_up(self, state):
-        demand = state.get("pending_demand", [])
+        from ray_tpu.autoscaler.instance_manager import pg_demand_classes
+
+        demand = list(state.get("pending_demand", []))
+        demand += pg_demand_classes(state.get("pending_pgs", []))
         if not demand:
             return
         # drop terminated launches from the in-flight record first

@@ -967,10 +967,16 @@ class GcsServer:
             ):
                 key = tuple(sorted(t["resources"].items()))
                 demand[key] += 1
-            for pg in self.placement_groups.values():
-                if pg["state"] == "PENDING":
-                    for b in pg["bundles"]:
-                        demand[tuple(sorted(b.items()))] += 1
+            # PENDING placement groups ship separately WITH their strategy:
+            # the autoscaler folds them strategy-aware (STRICT_PACK bundles
+            # must co-land on one node — per-bundle folding would split
+            # them across candidates and under-size the launch)
+            pending_pgs = [
+                {"bundles": [dict(b) for b in pg["bundles"]],
+                 "strategy": pg.get("strategy", "PACK")}
+                for pg in self.placement_groups.values()
+                if pg["state"] == "PENDING"
+            ]
             running_per_node: Dict[str, int] = defaultdict(int)
             for info in self.running.values():
                 running_per_node[info["node_id"]] += 1
@@ -992,6 +998,7 @@ class GcsServer:
                 "pending_demand": [
                     {"resources": dict(k), "count": v} for k, v in demand.items()
                 ],
+                "pending_pgs": pending_pgs,
                 "nodes": nodes,
             }
 

@@ -1,0 +1,206 @@
+"""Autoscaler v2 tests: instance-lifecycle state machine + reconciler.
+
+Reference analogs: python/ray/autoscaler/v2/tests/test_instance_manager.py
+(transition validation, history) and test_reconciler.py (provider/GCS
+view convergence), plus the fake-multinode end-to-end pattern.
+"""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import FakeNodeProvider, NodeTypeConfig
+from ray_tpu.autoscaler.instance_manager import (
+    AutoscalerV2,
+    InstanceManager,
+    InstanceStatus,
+    InvalidTransition,
+    pg_demand_classes,
+)
+from ray_tpu.cluster import Cluster
+
+
+# ------------------------------------------------------------ state machine
+
+
+def test_instance_walks_legal_lifecycle_with_history():
+    im = InstanceManager()
+    inst = im.create_instance("cpu2", {"CPU": 2})
+    assert inst.status == InstanceStatus.QUEUED
+    for nxt in (InstanceStatus.REQUESTED, InstanceStatus.ALLOCATED,
+                InstanceStatus.RAY_RUNNING, InstanceStatus.RAY_STOPPING,
+                InstanceStatus.TERMINATING, InstanceStatus.TERMINATED):
+        im.update_status(inst.instance_id, nxt, reason=f"to {nxt}")
+    got = im.get(inst.instance_id)
+    assert got.status == InstanceStatus.TERMINATED
+    # full audit trail: created + 6 transitions, each with a reason
+    assert len(got.history) == 7
+    assert [h[2] for h in got.history[1:]] == [
+        InstanceStatus.REQUESTED, InstanceStatus.ALLOCATED,
+        InstanceStatus.RAY_RUNNING, InstanceStatus.RAY_STOPPING,
+        InstanceStatus.TERMINATING, InstanceStatus.TERMINATED,
+    ]
+    assert all(h[3] for h in got.history[1:])
+
+
+def test_illegal_transitions_raise():
+    im = InstanceManager()
+    inst = im.create_instance("cpu2", {"CPU": 2})
+    with pytest.raises(InvalidTransition):
+        im.update_status(inst.instance_id, InstanceStatus.RAY_RUNNING)
+    with pytest.raises(InvalidTransition):
+        im.update_status(inst.instance_id, InstanceStatus.TERMINATING)
+    im.update_status(inst.instance_id, InstanceStatus.REQUESTED)
+    im.update_status(inst.instance_id, InstanceStatus.ALLOCATION_FAILED)
+    # terminal states accept nothing
+    with pytest.raises(InvalidTransition):
+        im.update_status(inst.instance_id, InstanceStatus.REQUESTED)
+
+
+def test_drain_can_be_cancelled():
+    im = InstanceManager()
+    inst = im.create_instance("cpu2", {"CPU": 2})
+    for nxt in (InstanceStatus.REQUESTED, InstanceStatus.ALLOCATED,
+                InstanceStatus.RAY_RUNNING, InstanceStatus.RAY_STOPPING):
+        im.update_status(inst.instance_id, nxt)
+    im.update_status(inst.instance_id, InstanceStatus.RAY_RUNNING,
+                     "demand returned")
+    assert im.get(inst.instance_id).status == InstanceStatus.RAY_RUNNING
+
+
+def test_counts_by_type():
+    im = InstanceManager()
+    a = im.create_instance("a", {"CPU": 1})
+    im.create_instance("a", {"CPU": 1})
+    im.create_instance("b", {"CPU": 1})
+    im.update_status(a.instance_id, InstanceStatus.REQUESTED)
+    im.update_status(a.instance_id, InstanceStatus.ALLOCATION_FAILED)
+    assert im.counts_by_type({InstanceStatus.QUEUED}) == {"a": 1, "b": 1}
+
+
+# --------------------------------------------------------- PG-aware demand
+
+
+def test_pg_demand_strict_pack_sums_bundles():
+    classes = pg_demand_classes([
+        {"strategy": "STRICT_PACK",
+         "bundles": [{"CPU": 2}, {"CPU": 3, "memory": 8.0}]},
+    ])
+    assert classes == [
+        {"resources": {"CPU": 5.0, "memory": 8.0}, "count": 1}
+    ]
+
+
+def test_pg_demand_pack_per_bundle():
+    classes = pg_demand_classes([
+        {"strategy": "PACK", "bundles": [{"CPU": 2}, {"CPU": 2}]},
+    ])
+    assert classes == [
+        {"resources": {"CPU": 2}, "count": 1},
+        {"resources": {"CPU": 2}, "count": 1},
+    ]
+
+
+# ------------------------------------------------------------- reconciler
+
+
+class FlakyProvider(FakeNodeProvider):
+    """First N create calls fail (reference: testing launch-failure
+    handling in the v2 reconciler)."""
+
+    def __init__(self, *a, fail_first=1, **kw):
+        super().__init__(*a, **kw)
+        self._fail = fail_first
+        self._fail_lock = threading.Lock()
+
+    def create_node(self, node_type, resources):
+        with self._fail_lock:
+            if self._fail > 0:
+                self._fail -= 1
+                raise RuntimeError("simulated cloud launch failure")
+        return super().create_node(node_type, resources)
+
+
+@pytest.mark.slow
+def test_v2_end_to_end_lifecycle_and_retry():
+    """Demand -> QUEUED -> ... -> RAY_RUNNING (with one launch failure
+    retried through a fresh record), then idle -> RAY_STOPPING ->
+    TERMINATED, provider empty again."""
+    c = Cluster()
+    provider = FlakyProvider(
+        (c.host, c.gcs.port), config=c.config, fail_first=1
+    )
+    scaler = AutoscalerV2(
+        (c.host, c.gcs.port), provider,
+        [NodeTypeConfig("cpu2", {"CPU": 2, "memory": 2**30},
+                        min_workers=0, max_workers=4)],
+        idle_timeout_s=2.0, update_interval_s=0.3,
+    ).start()
+    ray_tpu.init(address=c.address)
+    try:
+        @ray_tpu.remote(num_cpus=1)
+        def work(t):
+            time.sleep(t)
+            return 1
+
+        refs = [work.remote(1.0) for _ in range(4)]
+        assert sum(ray_tpu.get(refs, timeout=120)) == 4
+
+        # the failed launch is recorded terminally AND retried
+        failed = scaler.im.instances({InstanceStatus.ALLOCATION_FAILED})
+        assert len(failed) == 1
+        assert "simulated cloud launch failure" in failed[0].history[-1][3]
+        ran = scaler.im.instances({InstanceStatus.RAY_RUNNING,
+                                   InstanceStatus.RAY_STOPPING,
+                                   InstanceStatus.TERMINATING,
+                                   InstanceStatus.TERMINATED})
+        assert len(ran) >= 1
+
+        # idle reclamation drives instances to TERMINATED via the drain
+        deadline = time.time() + 40
+        while time.time() < deadline and provider.non_terminated_nodes():
+            time.sleep(0.5)
+        assert provider.non_terminated_nodes() == []
+        for inst in scaler.im.instances():
+            assert inst.status in (InstanceStatus.TERMINATED,
+                                   InstanceStatus.ALLOCATION_FAILED)
+            # every terminated instance passed through the full chain
+            if inst.status == InstanceStatus.TERMINATED:
+                seen = [h[2] for h in inst.history]
+                assert InstanceStatus.RAY_RUNNING in seen
+                assert InstanceStatus.RAY_STOPPING in seen
+    finally:
+        ray_tpu.shutdown()
+        scaler.shutdown()
+        provider.shutdown()
+        c.shutdown()
+
+
+@pytest.mark.slow
+def test_v2_pending_pg_triggers_launch():
+    """A PENDING placement group (no plain task demand at all) must size
+    the launch — strategy-aware (reference: v2/scheduler.py gang
+    resource requests)."""
+    from ray_tpu.util.placement_group import placement_group
+
+    c = Cluster()
+    provider = FakeNodeProvider((c.host, c.gcs.port), config=c.config)
+    scaler = AutoscalerV2(
+        (c.host, c.gcs.port), provider,
+        [NodeTypeConfig("cpu4", {"CPU": 4, "memory": 2**30},
+                        min_workers=0, max_workers=4)],
+        idle_timeout_s=30.0, update_interval_s=0.3,
+    ).start()
+    ray_tpu.init(address=c.address)
+    try:
+        pg = placement_group([{"CPU": 2}, {"CPU": 2}], strategy="STRICT_PACK")
+        assert pg.ready(timeout=120)
+        # STRICT_PACK {2,2} must co-land: exactly one cpu4 node suffices
+        assert len(provider.non_terminated_nodes()) == 1
+    finally:
+        ray_tpu.shutdown()
+        scaler.shutdown()
+        provider.shutdown()
+        c.shutdown()
