@@ -21,7 +21,7 @@ import numpy as np
 @dataclass
 class AlgorithmConfig:
     env: Any = "CartPole-v1"
-    algo: str = "pg"  # "pg" (REINFORCE+baseline) | "ppo" (clip)
+    algo: str = "pg"  # "pg" (REINFORCE+baseline) | "ppo" (clip) | "dqn"
     num_env_runners: int = 2
     rollout_fragment_length: int = 512
     train_batch_size: int = 2048
@@ -30,6 +30,13 @@ class AlgorithmConfig:
     hidden: int = 64
     seed: int = 0
     num_updates_per_iter: int = 1
+    # dqn only (reference: rllib/algorithms/dqn/dqn.py config surface)
+    replay_capacity: int = 50_000
+    learning_starts: int = 1_000  # env steps before the first update
+    target_sync_every: int = 250  # updates between target-network syncs
+    epsilon_start: float = 1.0
+    epsilon_end: float = 0.05
+    epsilon_decay_steps: int = 7_000
 
     # builder-style helpers (reference: AlgorithmConfig chaining)
     def environment(self, env) -> "AlgorithmConfig":
@@ -68,15 +75,34 @@ class Algorithm:
 
         self.config = config
         probe = make_env(config.env, seed=config.seed)
-        self.learner_group = LearnerGroup(
-            obs_size=probe.observation_size,
-            num_actions=probe.num_actions,
-            lr=config.lr,
-            algo=config.algo,
-            hidden=config.hidden,
-            train_batch_size=config.train_batch_size,
-            seed=config.seed,
-        )
+        self.replay = None
+        if config.algo == "dqn":
+            from ray_tpu.rllib.learner import DQNLearner
+            from ray_tpu.rllib.replay_buffer import ReplayBuffer
+
+            self.learner_group = LearnerGroup(learner=DQNLearner(
+                obs_size=probe.observation_size,
+                num_actions=probe.num_actions,
+                lr=config.lr,
+                hidden=config.hidden,
+                gamma=config.gamma,
+                target_sync_every=config.target_sync_every,
+                seed=config.seed,
+            ))
+            self.replay = ray_tpu.remote(ReplayBuffer).remote(
+                config.replay_capacity, config.seed
+            )
+            self._env_steps = 0
+        else:
+            self.learner_group = LearnerGroup(
+                obs_size=probe.observation_size,
+                num_actions=probe.num_actions,
+                lr=config.lr,
+                algo=config.algo,
+                hidden=config.hidden,
+                train_batch_size=config.train_batch_size,
+                seed=config.seed,
+            )
         runner_cls = ray_tpu.remote(EnvRunner)
         self.env_runners = [
             runner_cls.remote(
@@ -93,6 +119,8 @@ class Algorithm:
         """One iteration: broadcast weights -> parallel sample -> learn."""
         import ray_tpu
 
+        if self.config.algo == "dqn":
+            return self._train_dqn()
         t0 = time.time()
         weights = self.learner_group.get_weights()
         batches = ray_tpu.get(
@@ -124,18 +152,78 @@ class Algorithm:
             **stats,
         }
 
+    def _train_dqn(self) -> Dict[str, Any]:
+        """One off-policy iteration (reference: dqn.py training_step):
+        epsilon-greedy sample -> push transitions to the replay actor ->
+        gradient updates on uniform replay samples -> periodic target
+        sync (inside the learner)."""
+        import ray_tpu
+
+        cfg = self.config
+        t0 = time.time()
+        frac = min(1.0, self._env_steps / max(cfg.epsilon_decay_steps, 1))
+        eps = cfg.epsilon_start + frac * (cfg.epsilon_end - cfg.epsilon_start)
+        weights = self.learner_group.get_weights()
+        batches = ray_tpu.get(
+            [r.sample_transitions.remote(weights, eps)
+             for r in self.env_runners],
+            timeout=600,
+        )
+        sampled = sum(len(b["obs"]) for b in batches)
+        self._env_steps += sampled
+        size = 0
+        for b in batches:
+            size = ray_tpu.get(self.replay.add_batch.remote({
+                k: b[k]
+                for k in ("obs", "actions", "rewards", "next_obs", "dones")
+            }))
+        stats: Dict[str, float] = {}
+        if size >= cfg.learning_starts:
+            # pipeline: request the next replay sample while the learner
+            # chews on the current one (no trailing prefetch — the last
+            # update consumes the last request)
+            nxt = self.replay.sample.remote(cfg.train_batch_size)
+            for u in range(cfg.num_updates_per_iter):
+                batch = ray_tpu.get(nxt)
+                if u + 1 < cfg.num_updates_per_iter:
+                    nxt = self.replay.sample.remote(cfg.train_batch_size)
+                stats = self.learner_group.update(batch)
+        self.iteration += 1
+        ep_means = [
+            float(b["episode_reward_mean"]) for b in batches
+            if not np.isnan(b["episode_reward_mean"])
+        ]
+        return {
+            "training_iteration": self.iteration,
+            "episode_reward_mean": (
+                float(np.mean(ep_means)) if ep_means else float("nan")
+            ),
+            "num_env_steps_sampled": self._env_steps,
+            "replay_buffer_size": int(size),
+            "epsilon": round(eps, 4),
+            "time_this_iter_s": round(time.time() - t0, 3),
+            **stats,
+        }
+
     # ----------------------------------------------------- checkpointing
 
     def save(self, checkpoint_dir: str) -> str:
         os.makedirs(checkpoint_dir, exist_ok=True)
         path = os.path.join(checkpoint_dir, "algorithm_state.pkl")
+        state = {
+            "weights": self.learner_group.get_weights(),
+            "opt_state": self.learner_group.learner.opt_state,
+            "iteration": self.iteration,
+            "config": self.config,
+        }
+        if self.config.algo == "dqn":
+            # off-policy extras: without these a restore resets epsilon to
+            # its start value and loses the target-sync phase
+            state["env_steps"] = self._env_steps
+            state["updates"] = self.learner_group.learner._updates
+            state["target_params"] = self.learner_group.learner.target_params
         with open(path, "wb") as f:
-            pickle.dump({
-                "weights": self.learner_group.get_weights(),
-                "opt_state": self.learner_group.learner.opt_state,
-                "iteration": self.iteration,
-                "config": self.config,
-            }, f)
+            pickle.dump(state, f)
         return checkpoint_dir
 
     def restore(self, checkpoint_dir: str) -> None:
@@ -146,6 +234,10 @@ class Algorithm:
         self.learner_group.set_weights(state["weights"])
         self.learner_group.learner.opt_state = state["opt_state"]
         self.iteration = state["iteration"]
+        if self.config.algo == "dqn" and "env_steps" in state:
+            self._env_steps = state["env_steps"]
+            self.learner_group.learner._updates = state["updates"]
+            self.learner_group.learner.target_params = state["target_params"]
 
     def get_weights(self):
         return self.learner_group.get_weights()
@@ -156,5 +248,10 @@ class Algorithm:
         for r in self.env_runners:
             try:
                 ray_tpu.kill(r)
+            except Exception:  # noqa: BLE001
+                pass
+        if self.replay is not None:
+            try:
+                ray_tpu.kill(self.replay)
             except Exception:  # noqa: BLE001
                 pass

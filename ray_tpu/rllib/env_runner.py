@@ -89,3 +89,52 @@ class EnvRunner:
                 if self._ep_rewards_window else np.nan
             ),
         }
+
+    def sample_transitions(self, params, epsilon: float) -> Dict[str, np.ndarray]:
+        """Off-policy collection: epsilon-greedy over Q-values, returning
+        raw (s, a, r, s', done) transitions for a replay buffer
+        (reference: the DQN family's EnvRunner sampling path)."""
+        from ray_tpu.rllib import policy as pol
+
+        self._samples += 1
+        rng = np.random.default_rng(
+            (self._seed * 1_000_003 + self._samples) % (2**31)
+        )
+        n_act = self.env.num_actions
+        obs_buf, act_buf, rew_buf, next_buf, done_buf = [], [], [], [], []
+        for _ in range(self.rollout_fragment_length):
+            if rng.random() < epsilon:
+                a = int(rng.integers(n_act))
+            else:
+                a = int(np.asarray(
+                    pol.q_values(params, self._obs[None, :])
+                ).argmax())
+            next_obs, r, term, trunc, _ = self.env.step(a)
+            obs_buf.append(self._obs)
+            act_buf.append(a)
+            rew_buf.append(r)
+            next_buf.append(next_obs)
+            # bootstrap through time-limit truncation: only TERMINAL
+            # transitions cut the TD target (reference: dqn handles
+            # truncated episodes by bootstrapping)
+            done_buf.append(1.0 if term else 0.0)
+            self._ep_reward += r
+            self._obs = next_obs
+            if term or trunc:
+                self._ep_rewards_window.append(self._ep_reward)
+                self._ep_rewards_window = self._ep_rewards_window[-20:]
+                self._ep_reward = 0.0
+                self._episodes += 1
+                self._obs, _ = self.env.reset()
+        return {
+            "obs": np.asarray(obs_buf, np.float32),
+            "actions": np.asarray(act_buf, np.int32),
+            "rewards": np.asarray(rew_buf, np.float32),
+            "next_obs": np.asarray(next_buf, np.float32),
+            "dones": np.asarray(done_buf, np.float32),
+            "episode_reward_mean": np.float32(
+                np.mean(self._ep_rewards_window)
+                if self._ep_rewards_window else np.nan
+            ),
+            "episodes_done": np.int64(self._episodes),
+        }

@@ -93,8 +93,12 @@ class LearnerGroup:
     which this image can't exercise. The group API matches the reference
     so that seam is ready."""
 
-    def __init__(self, **learner_kwargs):
-        self.learner = Learner(**learner_kwargs)
+    def __init__(self, learner: Optional[Any] = None, **learner_kwargs):
+        # a prebuilt learner (e.g. DQNLearner) keeps the group the single
+        # construction seam for every algorithm family
+        self.learner = learner if learner is not None else Learner(
+            **learner_kwargs
+        )
 
     def update(self, batch) -> Dict[str, float]:
         return self.learner.update(batch)
@@ -104,3 +108,53 @@ class LearnerGroup:
 
     def set_weights(self, w):
         self.learner.set_weights(w)
+
+
+class DQNLearner:
+    """Off-policy Q-learning with a frozen target network (reference:
+    rllib/algorithms/dqn/ — the learner half; replay lives in its own
+    actor, replay_buffer.py). Same jitted-single-program shape as the
+    on-policy Learner: replay batches are a fixed size, so the update
+    compiles once."""
+
+    def __init__(self, obs_size: int, num_actions: int, lr: float = 1e-3,
+                 hidden: int = 64, gamma: float = 0.99,
+                 target_sync_every: int = 250, seed: int = 0):
+        from ray_tpu.rllib import policy as pol
+
+        self.gamma = gamma
+        self.target_sync_every = target_sync_every
+        self.params = pol.init_params(
+            np.random.default_rng(seed), obs_size, num_actions, hidden
+        )
+        self.target_params = self.params
+        self.optimizer = pol.make_optimizer(lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self._updates = 0
+
+    def get_weights(self):
+        return self.params
+
+    def set_weights(self, params):
+        self.params = params
+        self.target_params = params
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        import jax.numpy as jnp
+
+        from ray_tpu.rllib import policy as pol
+
+        jb = {
+            k: jnp.asarray(batch[k])
+            for k in ("obs", "actions", "rewards", "next_obs", "dones")
+        }
+        self.params, self.opt_state, stats = pol.dqn_update(
+            self.params, self.target_params, self.opt_state, jb,
+            self.optimizer, self.gamma,
+        )
+        self._updates += 1
+        if self._updates % self.target_sync_every == 0:
+            self.target_params = self.params
+        return {k: float(v) for k, v in stats.items()} | {
+            "num_updates": self._updates,
+        }

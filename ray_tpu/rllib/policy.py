@@ -134,3 +134,55 @@ def ppo_update(params, opt_state, batch, optimizer, clip: float = 0.2):
     return params, opt_state, {
         "total_loss": loss, "pg_loss": pg_l, "vf_loss": v_l,
     }
+
+
+# ------------------------------------------------------------------ DQN
+
+# The Q-network reuses the same MLP: the "wp" head read as Q-values per
+# action instead of logits (reference: rllib/algorithms/dqn/ — separate
+# algorithm, shared model tower idea).
+
+@jax.jit
+def q_values(params, obs):
+    return _trunk(params, obs) @ params["wp"] + params["bp"]
+
+
+@functools.partial(jax.jit, static_argnames=("optimizer",))
+def dqn_update(params, target_params, opt_state, batch, optimizer,
+               gamma: float = 0.99):
+    """One jitted Q-learning step over a replay batch.
+
+    batch: obs [B, O], actions [B], rewards [B], next_obs [B, O],
+    dones [B] (1.0 at terminal). DOUBLE-DQN target — the online network
+    picks the next action, the frozen target network evaluates it
+    (reference: dqn.py double_q=True default) — with Huber loss
+    (dqn_tf_policy's clipped TD error)."""
+    def loss_fn(p):
+        q = _trunk(p, batch["obs"]) @ p["wp"] + p["bp"]
+        qa = jnp.take_along_axis(
+            q, batch["actions"][:, None].astype(jnp.int32), axis=1
+        ).squeeze(-1)
+        q_next_online = _trunk(p, batch["next_obs"]) @ p["wp"] + p["bp"]
+        a_next = jnp.argmax(q_next_online, axis=-1)
+        q_next_t = (
+            _trunk(target_params, batch["next_obs"]) @ target_params["wp"]
+            + target_params["bp"]
+        )
+        q_next = jnp.take_along_axis(
+            q_next_t, a_next[:, None], axis=1
+        ).squeeze(-1)
+        target = batch["rewards"] + gamma * (
+            1.0 - batch["dones"]
+        ) * q_next
+        td = qa - jax.lax.stop_gradient(target)
+        loss = optax.huber_loss(td, jnp.zeros_like(td)).mean()
+        return loss, (jnp.abs(td).mean(), q.mean())
+
+    (loss, (td_abs, q_mean)), grads = jax.value_and_grad(
+        loss_fn, has_aux=True
+    )(params)
+    updates, opt_state = optimizer.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    return params, opt_state, {
+        "total_loss": loss, "td_error_abs": td_abs, "q_mean": q_mean,
+    }

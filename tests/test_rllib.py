@@ -155,3 +155,70 @@ def test_checkpoint_roundtrip(local_rt, tmp_path):
             )
     finally:
         algo2.stop()
+
+
+def test_dqn_learns_cartpole(local_rt):
+    """Off-policy training curve (reference: rllib/algorithms/dqn/ tuned
+    CartPole): epsilon-greedy collection into a replay-buffer ACTOR,
+    uniform replay sampling, target-network Q-learning. Mean episode
+    reward must clearly improve, and the replay actor must have seen
+    sustained add/sample traffic through the object store."""
+    import ray_tpu
+
+    algo = (
+        AlgorithmConfig(
+            algo="dqn",
+            rollout_fragment_length=256,
+            train_batch_size=128,
+            num_updates_per_iter=64,
+            lr=1e-3,
+            learning_starts=1_000,
+            target_sync_every=100,
+            epsilon_decay_steps=4_000,
+        )
+        .environment("CartPole-v1")
+        .env_runners(2, rollout_fragment_length=256)
+        .build()
+    )
+    try:
+        first = None
+        best = -np.inf
+        for i in range(60):
+            r = algo.train()
+            m = r["episode_reward_mean"]
+            if first is None and not np.isnan(m):
+                first = m
+            if not np.isnan(m):
+                best = max(best, m)
+            if best > 130:
+                break
+        assert first is not None
+        assert best > max(100.0, first * 1.5), (first, best)
+        stats = ray_tpu.get(algo.replay.stats.remote())
+        assert stats["added"] >= algo.config.learning_starts
+        assert stats["size"] > 0
+        assert r["num_updates"] >= 32  # learner actually trained
+    finally:
+        algo.stop()
+
+
+def test_replay_buffer_ring_and_sampling(local_rt):
+    """Unit: ring wrap-around keeps the newest `capacity` transitions;
+    samples draw only from real data."""
+    from ray_tpu.rllib.replay_buffer import ReplayBuffer
+
+    rb = ReplayBuffer(capacity=10, seed=0)
+    mk = lambda lo, n: {
+        "obs": np.arange(lo, lo + n, dtype=np.float32)[:, None],
+        "actions": np.zeros(n, np.int32),
+    }
+    rb.add_batch(mk(0, 8))
+    assert rb.size() == 8
+    rb.add_batch(mk(8, 6))  # wraps: ring now holds 4..13
+    assert rb.size() == 10
+    vals = set()
+    for _ in range(50):
+        s = rb.sample(10)
+        vals.update(int(v) for v in s["obs"].ravel())
+    assert vals <= set(range(4, 14))
+    assert max(vals) == 13
