@@ -97,7 +97,22 @@ class ActorEventLoop:
             return r
 
         fut = asyncio.run_coroutine_threadsafe(_invoke(), self.loop)
-        return fut.result()
+        # Not a bare fut.result(): a call racing shutdown() can slip its
+        # bridge callback into the loop's queue after the drain's last
+        # cycle — loop.close() then discards it and the future never
+        # resolves. Poll with a bound so the dispatch thread surfaces the
+        # actor's death instead of wedging forever.
+        import concurrent.futures as _cf
+
+        while True:
+            try:
+                return fut.result(timeout=0.5)
+            except _cf.TimeoutError:
+                if self._closed and not self._thread.is_alive():
+                    fut.cancel()
+                    raise RuntimeError(
+                        "actor event loop shut down during call"
+                    ) from None
 
     def shutdown(self, join_timeout: float = 2.0):
         """Cancel every in-flight coroutine and stop the loop. Dispatch

@@ -150,9 +150,13 @@ class ObjectRefGenerator:
         # the store unconsumed; normal eviction reclaims them).
         if self._ack and not self.completed():
             try:
-                from ray_tpu.core.api import _get_runtime
+                from ray_tpu.core import api as _api
 
-                _get_runtime().stream_ack(self._task_id, 1 << 30)
+                # only an ALREADY-LIVE runtime: _get_runtime() would
+                # auto-init a fresh one if GC runs after shutdown()
+                rt = _api._runtime
+                if rt is not None:
+                    rt.stream_ack(self._task_id, 1 << 30)
             except Exception:  # noqa: BLE001 - interpreter teardown etc.
                 pass
 

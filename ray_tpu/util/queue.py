@@ -127,6 +127,10 @@ class Queue:
                 raise Full("queue full")
             return
         deadline = None if timeout is None else time.monotonic() + timeout
+        # ship the payload ONCE: retries across parking slices pass the
+        # ref, which the actor's node resolves from its local store cache
+        # instead of re-receiving the full item every slice
+        ref = ray_tpu.put(item)
         while True:
             remaining = (
                 self._SLICE_S if deadline is None
@@ -134,7 +138,7 @@ class Queue:
             )
             if remaining <= 0:
                 raise Full("queue full")
-            if ray_tpu.get(self._actor.put.remote(item, remaining)):
+            if ray_tpu.get(self._actor.put.remote(ref, remaining)):
                 return
             if deadline is not None and time.monotonic() >= deadline:
                 raise Full("queue full")
