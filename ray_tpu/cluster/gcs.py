@@ -1271,9 +1271,12 @@ class GcsServer:
         Work per round is O(classes + dispatched + new arrivals), never
         O(total queued)."""
         pg_work: List[tuple] = []
+        pipelined = getattr(self.policy, "pipelined", False)
         with self._lock:
             deps_lost_round = self._intake_locked()
             have_work = bool(self._class_buckets) or bool(self._special_queue)
+            if pipelined and self.policy.has_inflight():
+                have_work = True  # trailing pipeline rounds still flushing
             if not have_work:
                 pg_work = self._retry_pending_pgs_locked()
         if not have_work:
@@ -1286,23 +1289,48 @@ class GcsServer:
                 k for k, b in self._class_buckets.items() if b["q"]
             ]
             dispatches: List[tuple] = []
-            if keys:
-                demands = np.stack(
-                    [self._class_buckets[k]["demand"] for k in keys]
-                )
-                counts = np.array(
-                    [len(self._class_buckets[k]["q"]) for k in keys],
-                    dtype=np.int32,
-                )
-                assigned = self.policy.schedule(self.state, demands, counts)
-                for c, key in enumerate(keys):
-                    q = self._class_buckets[key]["q"]
+            plan = None  # (keys_r, demands_r, assigned) to dispatch NOW
+            if keys or (pipelined and self.policy.has_inflight()):
+                if keys:
+                    demands = np.stack(
+                        [self._class_buckets[k]["demand"] for k in keys]
+                    )
+                    counts = np.array(
+                        [len(self._class_buckets[k]["q"]) for k in keys],
+                        dtype=np.int32,
+                    )
+                else:
+                    demands = np.zeros(
+                        (0, self.state.available.shape[1]), np.float32
+                    )
+                    counts = np.zeros((0,), np.int32)
+                if pipelined:
+                    # deep-pipelined device rounds: this round's problem is
+                    # ENQUEUED; the returned assignment (if any) belongs to
+                    # an earlier round whose tasks are still queued — see
+                    # HybridPolicy.schedule_pipelined
+                    plan = self.policy.schedule_pipelined(
+                        self.state, demands, counts, keys
+                    )
+                else:
+                    plan = (
+                        keys, demands,
+                        self.policy.schedule(self.state, demands, counts),
+                    )
+            if plan is not None:
+                keys_r, demands_r, assigned = plan
+                for c, key in enumerate(keys_r):
+                    b = self._class_buckets.get(key)
                     row = assigned[c]
                     for n in np.flatnonzero(row):
                         for _ in range(int(row[n])):
-                            if not q:
-                                break
-                            t = q.popleft()
+                            if b is None or not b["q"]:
+                                # the task vanished between submission and
+                                # this (possibly lagged) result — credit
+                                # the kernel's debit back
+                                self.state.release(int(n), demands_r[c])
+                                continue
+                            t = b["q"].popleft()
                             self._queued_ids.discard(t["task_id"])
                             if t.get("actor_creation"):
                                 # killed while queued in the bucket
@@ -1311,14 +1339,14 @@ class GcsServer:
                                     self._track_exit(t)
                                     # the kernel already debited this slot;
                                     # release it
-                                    idx = int(n)
-                                    self.state.release(idx, demands[c])
+                                    self.state.release(int(n), demands_r[c])
                                     continue
-                            dispatches.append((t, int(n), demands[c]))
+                            dispatches.append((t, int(n), demands_r[c]))
                 # drop emptied buckets so dead classes don't pad the kernel
-                for k in keys:
-                    if not self._class_buckets[k]["q"]:
-                        del self._class_buckets[k]
+                for key in keys_r:
+                    b = self._class_buckets.get(key)
+                    if b is not None and not b["q"]:
+                        del self._class_buckets[key]
 
             failed: List[tuple] = []
             for _ in range(len(self._special_queue)):

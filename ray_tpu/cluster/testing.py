@@ -74,12 +74,23 @@ def run_rounds_to_quiescence(
     gcs,
     max_rounds: int = 400,
     drain_fraction: float = 0.5,
+    time_budget_s: "Optional[float]" = None,
 ) -> Dict[str, str]:
     """Alternate _schedule_round with completing a slice of running tasks
     (freeing resources — the dirty-row release path) until the queue drains.
-    Returns {task_id: node_id} placements in dispatch order."""
+    Returns {task_id: node_id} placements in dispatch order. A time budget
+    (benchmarks on a degraded device tunnel) stops early; callers see the
+    shortfall in the returned placement count."""
+    import time as _time
+
+    deadline = (
+        _time.monotonic() + time_budget_s
+        if time_budget_s is not None else None
+    )
     placements: Dict[str, str] = {}
     for _ in range(max_rounds):
+        if deadline is not None and _time.monotonic() > deadline:
+            break
         gcs._schedule_round()
         with gcs._lock:
             for tid, info in gcs.running.items():

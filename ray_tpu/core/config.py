@@ -23,6 +23,11 @@ _DEFS: Dict[str, tuple] = {
     # on the bit-identical NumPy twin (device dispatch latency dominates
     # small solves); 0 = always use the device
     "jax_policy_min_cells": (int, 262_144),
+    # device rounds in flight before the oldest is forced: deep pipelining
+    # amortizes per-dispatch link latency (~67ms/sync on a degraded axon
+    # tunnel vs ~5ms/round for 16 chained enqueues). 0 = synchronous
+    # rounds (old behavior)
+    "jax_policy_pipeline_depth": (int, 8),
     # how long the dep gate honors an owner's "my in-flight actor call will
     # produce this object" voucher before node-death sweeps may re-evaluate
     # the dep (guards against owners that die/fail to publish an error)

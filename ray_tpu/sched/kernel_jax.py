@@ -507,6 +507,99 @@ class JaxScheduler:
             jax.device_put(vv, self.device),
         )
 
+    def schedule_async(self, demands: np.ndarray, counts: np.ndarray,
+                       spread_threshold: float = DEFAULT_SPREAD_THRESHOLD,
+                       algo: str = "scan") -> dict:
+        """Enqueue one scheduling round WITHOUT any host<->device sync.
+
+        The returned handle's device array is narrow-dtyped and its
+        device->host copy is STARTED (copy_to_host_async); fetch() later
+        blocks only on whatever is still in flight. Chaining K rounds
+        through this path costs ~latency/K per round instead of a full
+        sync round trip each — the pipelined hot loop the north star's
+        <50ms/round clause needs on a tunneled device (measured here:
+        67ms per forced round trip vs ~5ms/round for 16 chained
+        enqueues)."""
+        pad = bucket_size(demands.shape[0])
+        d, k = pad_problem(
+            np.asarray(demands, np.float32), np.asarray(counts), pad
+        )
+        if algo in ("rounds", "chunked"):
+            active = tuple(int(i) for i in np.flatnonzero((d > 0).any(axis=0)))
+            fn = (
+                schedule_classes_chunked if algo == "chunked"
+                else schedule_classes_rounds
+            )
+            assigned, new_avail = fn(
+                self.avail, self.total, self.alive,
+                jnp.asarray(d), jnp.asarray(k), spread_threshold,
+                active_idx=active,
+            )
+        else:
+            assigned, new_avail = schedule_classes(
+                self.avail, self.total, self.alive,
+                jnp.asarray(d), jnp.asarray(k), spread_threshold,
+            )
+        self.avail = new_avail
+        out = assigned[: demands.shape[0]]
+        C, N = out.shape
+        # Sparse (COO) download when it shrinks the wire payload: the
+        # assignment matrix is mostly zeros (placements are bounded by the
+        # submitted counts), and on a tunneled device the payload IS the
+        # round's wall time. nonzero with a static `size` keeps shapes
+        # jit-stable (a few pow-2 cap buckets); padding slots replicate
+        # cell (0, 0), whose value is also shipped, so host-side
+        # assignment-reconstruction is exactly idempotent.
+        cap_needed = int(np.sum(counts, dtype=np.int64))
+        cap = next(
+            (b for b in self._NONZERO_BUCKETS if b >= cap_needed), None
+        )
+        m = int(np.max(counts, initial=0))
+        if cap is not None and cap * 5 < C * N:
+            ci, ni = jnp.nonzero(out, size=cap, fill_value=0)
+            vals = out[ci, ni]
+            ci = ci.astype(jnp.int16 if C < 32768 else jnp.int32)
+            ni = ni.astype(jnp.int16 if N < 32768 else jnp.int32)
+            if m < 256:
+                vals = vals.astype(jnp.uint8)
+            parts = {"ci": ci, "ni": ni, "vals": vals}
+            for p in parts.values():
+                try:
+                    p.copy_to_host_async()
+                except AttributeError:
+                    pass
+            return {"sparse": parts, "shape": (C, N)}
+        # dense fallback: narrow purely from HOST knowledge (a class
+        # places at most its own count on one node); never sync the
+        # device for the exact max
+        if m < 256:
+            out = out.astype(jnp.uint8)
+        elif m < 32768:
+            out = out.astype(jnp.int16)
+        try:
+            out.copy_to_host_async()
+        except AttributeError:  # older jax Array without the method
+            pass
+        return {"out": out}
+
+    # static caps for the sparse-download nonzero program (one compile per
+    # bucket, like the update_rows row buckets)
+    _NONZERO_BUCKETS = (1024, 4096, 16384, 65536, 262144)
+
+    def fetch(self, handle: dict) -> np.ndarray:
+        """Force a schedule_async handle to a host int32 [C, N] array."""
+        if "sparse" in handle:
+            s = handle["sparse"]
+            ci = np.asarray(s["ci"]).astype(np.int64)
+            ni = np.asarray(s["ni"]).astype(np.int64)
+            vals = np.asarray(s["vals"]).astype(np.int32)
+            dense = np.zeros(handle["shape"], np.int32)
+            # plain assignment, not add: every duplicate index pair is a
+            # padding replica of cell (0,0) carrying the same value
+            dense[ci, ni] = vals
+            return dense
+        return np.asarray(handle["out"]).astype(np.int32)
+
     def schedule(self, demands: np.ndarray, counts: np.ndarray,
                  spread_threshold: float = DEFAULT_SPREAD_THRESHOLD,
                  algo: str = "scan") -> np.ndarray:

@@ -12,6 +12,7 @@ BASELINE.json's north star.
 from __future__ import annotations
 
 import logging
+from collections import deque
 from typing import Optional, Tuple
 
 import numpy as np
@@ -90,7 +91,8 @@ class HybridPolicy(SchedulingPolicy):
     FULL_SYNC_INTERVAL = 64
 
     def __init__(self, spread_threshold: float = 0.5, backend: str = "numpy",
-                 algo: str = "scan", device_min_cells: int = 262_144):
+                 algo: str = "scan", device_min_cells: int = 262_144,
+                 pipeline_depth: int = 8):
         self.spread_threshold = spread_threshold
         self.backend = backend
         self.algo = algo
@@ -100,6 +102,15 @@ class HybridPolicy(SchedulingPolicy):
         # solve at small sizes, and the live GCS schedules MANY small
         # rounds between big ones. 0 forces every round onto the device.
         self.device_min_cells = device_min_cells
+        # pipelined device rounds (see schedule_pipelined): how many
+        # submitted rounds may be in flight before the oldest is forced
+        self.pipeline_depth = pipeline_depth
+        self._pipe: deque = deque()  # (tags, demands, submitted_counts, handle)
+        self._pipe_inflight: dict = {}  # tag-key -> submitted-but-unfetched
+        # fetched-but-undispatched results (window flushes buffer here; the
+        # caller drains one per round)
+        self._ready: deque = deque()
+        self._pipe_topology = None  # topology the in-flight window solved
         self._jax = None  # lazily built JaxScheduler (topology-dependent)
         self._topology_key = None
         self._rounds_since_full_sync = 0
@@ -113,8 +124,7 @@ class HybridPolicy(SchedulingPolicy):
         semantics), with the per-class feasible count memoized by demand
         bytes — totals only change on topology events, and rebuilding the
         [C, N, R] comparison every round at 10k nodes would cost ~10ms."""
-        key = (len(state.node_ids), state.total.tobytes(),
-               state.alive.tobytes())
+        key = self._topology_of(state)
         if self._feas_cache_key != key:
             self._feas_cache = {}
             self._feas_cache_key = key
@@ -137,7 +147,7 @@ class HybridPolicy(SchedulingPolicy):
     def _jax_sched(self, state: NodeResourceState):
         from ray_tpu.sched.kernel_jax import JaxScheduler
 
-        key = (len(state.node_ids), state.total.tobytes(), state.alive.tobytes())
+        key = self._topology_of(state)
         if self._jax is None or self._topology_key != key:
             self._jax = JaxScheduler(state.total, state.alive)
             self._topology_key = key
@@ -156,6 +166,190 @@ class HybridPolicy(SchedulingPolicy):
         elif dirty:
             self._jax.update_rows(dirty, state.available[dirty])
         return self._jax
+
+    # ------------------------------------------------ pipelined device path
+
+    @property
+    def pipelined(self) -> bool:
+        """True when the live control plane should drive this policy via
+        schedule_pipelined (jax backend with a pipeline window)."""
+        return self.backend == "jax" and self.pipeline_depth > 0
+
+    def has_inflight(self) -> bool:
+        return bool(self._pipe) or bool(self._ready)
+
+    def _topology_of(self, state) -> tuple:
+        # O(1): the version counter bumps on add/remove/revive — the only
+        # mutators of total/alive (tobytes() here cost ~2MB of memcpy per
+        # round at 10k nodes)
+        return (len(state.node_ids), state.topology_version)
+
+    def _fetch_one(self, state):
+        """Pop + force the oldest in-flight round; guard, debit the host,
+        release the in-flight counts. Returns a dispatch plan, or None if
+        the guard tripped (whole window discarded, device re-sync forced)."""
+        tags_r, demands_r, eff_r, handle = self._pipe.popleft()
+        assigned = self._jax.fetch(handle)[handle["inv"]]
+        for c, t in enumerate(tags_r):
+            left = self._pipe_inflight.get(t, 0) - int(eff_r[c])
+            if left > 0:
+                self._pipe_inflight[t] = left
+            else:
+                self._pipe_inflight.pop(t, None)
+        err, taken = _invariant_violation(
+            state.available, demands_r, eff_r, assigned
+        )
+        if err is not None:
+            logger.warning(
+                "pipelined jax_tpu round violated scheduling invariant "
+                "(%s); discarding the in-flight window and re-syncing "
+                "the device", err
+            )
+            self._discard_window()
+            return None
+        state.available = np.maximum(state.available - taken, 0.0)
+        return tags_r, demands_r, assigned
+
+    def _discard_window(self, state=None):
+        """Drop every in-flight round. With `state`, ALSO drop buffered
+        ready plans, crediting their host debits back — used on topology
+        changes, where a buffered plan may target a node that no longer
+        exists (its tasks stayed queued and simply reschedule)."""
+        self._pipe.clear()
+        self._pipe_inflight.clear()
+        if state is not None:
+            while self._ready:
+                _, demands_r, assigned = self._ready.popleft()
+                taken = assigned.astype(np.float32).T @ demands_r
+                state.available = np.minimum(
+                    state.available + taken, state.total
+                )
+        self._pipe_topology = None
+        self._rounds_since_full_sync = self.FULL_SYNC_INTERVAL
+
+    def _flush_pipe(self, state):
+        """Force every in-flight round into the ready buffer (results are
+        dispatched one per subsequent call — never dropped). Runs before
+        any host->device sync: syncing mid-window would overwrite the
+        device's in-flight debits with host values that lack them."""
+        while self._pipe:
+            plan = self._fetch_one(state)
+            if plan is not None:
+                self._ready.append(plan)
+
+    def schedule_pipelined(self, state, demands, counts, tags):
+        """Deep-pipelined device rounds for the LIVE control plane.
+
+        Instead of submit->sync->dispatch per round (one full link round
+        trip each — ~67ms on a degraded tunnel), rounds are ENQUEUED
+        against the device-resident availability (which the kernel
+        already carries forward on-device) and the oldest in-flight
+        round is forced only once the window fills. The caller receives
+        (tags, demands, assignment) of a PREVIOUS round — tasks stay
+        queued until their round's result lands, so placement simply
+        lags by the window depth while per-round cost drops to
+        ~latency/depth + compute.
+
+        Flow control: per-tag in-flight counts are subtracted from the
+        submitted queue depths so a task is never scheduled twice while
+        its round is still in flight. Unplaced remainders re-enter
+        automatically when their round is fetched.
+
+        Safety: the fetched assignment passes the same invariant guard
+        as the sync path, checked against the host availability at fetch
+        time (releases since submit only ADD availability, so the check
+        is conservative); on violation the whole pipeline is discarded
+        and the device fully re-synced.
+
+        tags: opaque per-class identifiers (the GCS passes its class
+        keys) used for the in-flight accounting and handed back with the
+        result so the caller can map rows to its queues.
+        """
+        if (
+            len(tags)
+            and not self._pipe
+            and not self._ready
+            and demands.shape[0] * len(state.node_ids)
+            < self.device_min_cells
+        ):
+            # small round with nothing in flight: the bit-identical NumPy
+            # twin wins below device_min_cells (a tunneled dispatch costs
+            # more than the whole solve), exactly as on the sync path.
+            # Mixing is safe only when the pipe is EMPTY — the twin reads
+            # host availability, which in-flight device rounds haven't
+            # debited yet.
+            return tags, demands, self.schedule(state, demands, counts)
+        # topology changed mid-window (node add/remove): in-flight rounds
+        # AND buffered ready plans solved a different cluster shape —
+        # discard both (ready plans could target a node that just died;
+        # their host debits are credited back and the tasks reschedule)
+        if (
+            (self._pipe or self._ready)
+            and self._pipe_topology is not None
+            and self._pipe_topology != self._topology_of(state)
+        ):
+            logger.info(
+                "pipelined jax_tpu: topology changed mid-window; "
+                "discarding %d in-flight + %d buffered rounds",
+                len(self._pipe), len(self._ready),
+            )
+            self._discard_window(state)
+        submitted = False
+        if len(tags):
+            state.enable_delta_log()  # mid-window syncs ride as increments
+            eff = np.asarray(counts).copy()
+            for c, t in enumerate(tags):
+                eff[c] = max(0, eff[c] - self._pipe_inflight.get(t, 0))
+            if eff.sum() > 0:
+                # An ABSOLUTE host->device sync (dirty rows / periodic
+                # full upload) would overwrite in-flight debits that
+                # exist only on the device. Mid-window, availability
+                # changes (completions releasing, out-of-band allocates)
+                # ship as accumulated DELTAS instead — correct on top of
+                # the device's in-flight state. Only the periodic
+                # float-drift guard still forces a flush-then-full-sync.
+                needs_full = (
+                    self._rounds_since_full_sync >= self.FULL_SYNC_INTERVAL
+                    or self._jax is None
+                    or self._topology_key != self._topology_of(state)
+                )
+                if self._pipe and needs_full:
+                    self._flush_pipe(state)
+                if self._pipe:
+                    sched = self._jax
+                    delta = state.consume_delta()
+                    if delta is not None:
+                        state.consume_dirty()  # subsumed by the delta
+                        sched.apply_delta(delta)
+                else:
+                    state.consume_delta()  # absolute sync supersedes it
+                    sched = self._jax_sched(state)
+                self._rounds_since_full_sync += 1
+                order = self._constrained_order(state, demands)
+                inv = np.empty_like(order)
+                inv[order] = np.arange(len(order))
+                handle = sched.schedule_async(
+                    demands[order], eff[order], self.spread_threshold,
+                    algo=self.algo,
+                )
+                handle["inv"] = inv
+                self._pipe.append((list(tags), demands, eff, handle))
+                self._pipe_topology = self._topology_of(state)
+                for c, t in enumerate(tags):
+                    self._pipe_inflight[t] = (
+                        self._pipe_inflight.get(t, 0) + int(eff[c])
+                    )
+                submitted = True
+        # dispatch: buffered results first, then the window's oldest once
+        # it overfills (or whenever nothing new was enqueued — the drain
+        # and flush tails must always make progress)
+        if self._ready:
+            return self._ready.popleft()
+        if not self._pipe:
+            return None
+        if submitted and len(self._pipe) <= self.pipeline_depth:
+            return None  # window still filling; nothing to dispatch yet
+        return self._fetch_one(state)
 
     def schedule(self, state, demands, counts):
         # most-constrained classes first (measured: turns the masked-
@@ -330,6 +524,7 @@ def make_policy_from_config(config) -> SchedulingPolicy:
         kw["spread_threshold"] = config.scheduler_spread_threshold
         kw["algo"] = config.scheduler_kernel_algo
         kw["device_min_cells"] = config.jax_policy_min_cells
+        kw["pipeline_depth"] = config.jax_policy_pipeline_depth
     return make_policy(name, **kw)
 
 

@@ -129,6 +129,44 @@ class NodeResourceState:
         # reference's resource-sync deltas (ray_syncer.cc): ship only what
         # changed, not the whole cluster view, every round.
         self.dirty_rows: set = set()
+        # Opt-in availability DELTA log (enable_delta_log): accumulates
+        # (new - old) per mutation so a device view that is mid-pipeline
+        # (holding in-flight debits the host hasn't applied yet) can be
+        # updated INCREMENTALLY — absolute row uploads would erase those
+        # debits. Consumers: HybridPolicy.schedule_pipelined ->
+        # JaxScheduler.apply_delta. Disabled by default: zero overhead for
+        # every other user of this class.
+        self._delta_enabled = False
+        self._delta_log: Optional[np.ndarray] = None
+        # bumped on any node add/remove/revive: O(1) topology identity for
+        # per-round cache keys (serializing total/alive with tobytes() at
+        # 10k nodes costs ~640KB of memcpy per check)
+        self.topology_version = 0
+
+    def enable_delta_log(self) -> None:
+        self._delta_enabled = True
+
+    def _log_delta(self, idx: int, applied: np.ndarray) -> None:
+        if not self._delta_enabled:
+            return
+        if (
+            self._delta_log is None
+            or self._delta_log.shape != self.available.shape
+        ):
+            old = self._delta_log
+            self._delta_log = np.zeros_like(self.available)
+            if old is not None and old.size:
+                self._delta_log[: old.shape[0]] = old
+        self._delta_log[idx] += applied
+
+    def consume_delta(self) -> Optional[np.ndarray]:
+        """Return-and-clear the accumulated availability delta matrix, or
+        None when nothing changed since the last consume."""
+        if self._delta_log is None:
+            return None
+        out = self._delta_log
+        self._delta_log = None
+        return out if out.any() else None
 
     def __len__(self) -> int:
         return len(self.node_ids)
@@ -152,6 +190,7 @@ class NodeResourceState:
         self.node_ids.append(node_id)
         self.labels.append(dict(labels or {}))
         self._index[node_id] = idx
+        self.topology_version += 1
         return idx
 
     def remove_node(self, node_id: str) -> None:
@@ -164,6 +203,7 @@ class NodeResourceState:
         self.alive[idx] = False
         self.available[idx] = 0.0
         self.total[idx] = 0.0
+        self.topology_version += 1
 
     def revive_node(self, node_id: str, resources: Mapping[str, float]) -> None:
         """Bring a dead row back (a daemon re-registered with the same id)."""
@@ -172,11 +212,15 @@ class NodeResourceState:
         self.total[idx] = vec
         self.available[idx] = vec.copy()
         self.alive[idx] = True
+        self.topology_version += 1
 
     def update_available(self, node_id: str, available: Mapping[str, float]) -> None:
         """Overwrite a node's availability from a sync report (ray_syncer-style)."""
         idx = self._index[node_id]
+        old = self.available[idx].copy() if self._delta_enabled else None
         self.available[idx] = self.space.vector(available)
+        if old is not None:
+            self._log_delta(idx, self.available[idx] - old)
         self.dirty_rows.add(idx)
 
     def allocate(self, node_idx: int, demand: np.ndarray) -> bool:
@@ -186,17 +230,23 @@ class NodeResourceState:
             return False
         if np.any(self.available[node_idx] + EPS < demand):
             return False
+        old = self.available[node_idx].copy() if self._delta_enabled else None
         self.available[node_idx] -= demand
         np.maximum(self.available[node_idx], 0.0, out=self.available[node_idx])
+        if old is not None:
+            self._log_delta(int(node_idx), self.available[node_idx] - old)
         self.dirty_rows.add(int(node_idx))
         return True
 
     def release(self, node_idx: int, demand: np.ndarray) -> None:
         if not self.alive[node_idx]:
             return
+        old = self.available[node_idx].copy() if self._delta_enabled else None
         self.available[node_idx] = np.minimum(
             self.available[node_idx] + demand, self.total[node_idx]
         )
+        if old is not None:
+            self._log_delta(int(node_idx), self.available[node_idx] - old)
         self.dirty_rows.add(int(node_idx))
 
     def replace_available(self, new_avail: np.ndarray) -> None:
@@ -204,6 +254,9 @@ class NodeResourceState:
         matrix) that keeps the dirty-row contract: every changed row is
         marked so device-view consumers stay in sync."""
         changed = np.flatnonzero((self.available != new_avail).any(axis=1))
+        if self._delta_enabled:
+            for i in changed:
+                self._log_delta(int(i), new_avail[i] - self.available[i])
         self.dirty_rows.update(int(i) for i in changed)
         self.available = new_avail
 
