@@ -1,0 +1,196 @@
+"""Node-axis-sharded scheduling kernel: the kernel under shard_map.
+
+The north star (BASELINE.json config 5) names "vectorized bin-packing
+... under pmap": design-for-N means the cluster matrix itself shards
+over a device mesh, not just fits one chip. Here the NODE axis of
+avail/total/alive splits into contiguous blocks across the mesh's
+``nodes`` axis (jax shards axis 0 contiguously); each device runs the
+same per-class pass as `kernel_jax.schedule_classes` over its block, and
+the few cross-block quantities ride collectives:
+
+  - feasible-node counts / placed totals: `psum` scalars;
+  - the (score-bucket, node-index) prefix order of the fill: per-shard
+    bucket totals are `all_gather`-ed, then shard- and bucket-level
+    exclusive prefixes recompose the GLOBAL prefix each local node sees.
+
+Decision equality with the single-device kernel is exact, not
+approximate: contiguous shard blocks preserve node order, saturating
+partial sums clamp at the same SAT=2**23 (any saturated component already
+exceeds every legal `remaining`, so take=0 on both sides; unsaturated
+prefixes are exact in float32) — golden-tested against
+`schedule_classes` on the virtual 8-device CPU mesh
+(tests/test_sched_shard.py).
+
+Reference anchor: the reference scales scheduling by sharding WORK over
+raylets (each ClusterTaskManager sees the whole cluster view); here the
+VIEW shards over chips and one program schedules the whole queue —
+ICI collectives instead of ray_syncer broadcasts.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_tpu.sched.kernel_jax import (
+    EPS,
+    INF_FIT,
+    MAX_PASSES,
+    SAT,
+    SCORE_BUCKETS,
+    _class_fit,
+    _score_bucket,
+    _sat_cumsum,
+    _threshold_cap,
+    critical_util,
+)
+
+
+def _fill_by_bucket_sharded(cap, bucket, remaining, axis_name):
+    """Global (bucket, node) prefix fill where this device holds one
+    contiguous node block. Mirrors kernel_jax._fill_by_bucket with the
+    prefix decomposed as
+        global_prev = bucket_offset(global) + shard_prefix(bucket)
+                      + within_shard_exclusive
+    every component saturated at SAT (pairwise, so each float32 add stays
+    on exact integers <= 2*SAT)."""
+    n_buckets = SCORE_BUCKETS
+    capf = jnp.minimum(cap, remaining).astype(jnp.float32)
+    onehot = (
+        bucket[None, :] == jnp.arange(n_buckets)[:, None]
+    ).astype(jnp.float32)
+    contrib = onehot * capf[None, :]  # [B, Nlocal]
+    shifted = jnp.concatenate(
+        [jnp.zeros((n_buckets, 1), jnp.float32), contrib[:, :-1]], axis=1
+    )
+    within_excl = _sat_cumsum(shifted, axis=1)  # [B, Nlocal]
+    local_tot = jnp.minimum(
+        within_excl[:, -1] + contrib[:, -1], jnp.float32(SAT)
+    )  # [B]
+    all_tot = jax.lax.all_gather(local_tot, axis_name)  # [p, B]
+    shard_scan = _sat_cumsum(all_tot, axis=0)  # [p, B] inclusive
+    idx = jax.lax.axis_index(axis_name)
+    shard_prefix = jnp.where(
+        idx > 0,
+        jnp.take(shard_scan, jnp.maximum(idx - 1, 0), axis=0),
+        jnp.zeros((n_buckets,), jnp.float32),
+    )  # [B] total of this bucket on earlier shards
+    bucket_tot = shard_scan[-1]  # [B] global per-bucket totals (saturated)
+    bucket_off = jnp.concatenate(
+        [jnp.zeros((1,), jnp.float32), _sat_cumsum(bucket_tot, axis=0)[:-1]]
+    )
+    base = jnp.minimum(bucket_off + shard_prefix, jnp.float32(SAT))  # [B]
+    prev_mat = base[:, None] + within_excl  # each term <= SAT: exact adds
+    prev = (prev_mat * onehot).sum(axis=0)  # [Nlocal]
+    take = jnp.clip(jnp.float32(remaining) - prev, 0.0, capf)
+    return take.astype(jnp.int32)
+
+
+def _one_class_sharded(avail, total, alive, d, count, thr, max_passes,
+                       axis_name):
+    Nl = avail.shape[0]
+
+    def cond(state):
+        _, remaining, _, p, stalled = state
+        return (remaining > 0) & (p < max_passes) & (~stalled)
+
+    def body(state):
+        avail, remaining, acc, p, _ = state
+        fit = _class_fit(avail, alive, d)
+        n_feasible = jax.lax.psum((fit > 0).sum(), axis_name)
+        util = critical_util(avail, total)
+        bucket = _score_bucket(util, thr)
+        cap_thresh = _threshold_cap(avail, total, d, thr)
+        equal_share = (
+            remaining + jnp.maximum(n_feasible, 1) - 1
+        ) // jnp.maximum(n_feasible, 1)
+        cap = jnp.where(
+            util < thr, cap_thresh, equal_share.astype(jnp.int32)
+        )
+        cap = jnp.minimum(jnp.minimum(cap, fit), remaining)
+        take = _fill_by_bucket_sharded(cap, bucket, remaining, axis_name)
+        got = jax.lax.psum(take.sum(), axis_name)
+        avail = jnp.maximum(
+            avail - take[:, None].astype(jnp.float32) * d[None, :], 0.0
+        )
+        stalled = (got == 0) | (n_feasible == 0)
+        return (avail, remaining - got, acc + take, p + 1, stalled)
+
+    # acc derives from avail so shard_map types it as per-shard VARYING
+    # (a plain zeros() would be replicated-typed and fail the while_loop
+    # carry check)
+    acc0 = (avail[:, 0] * 0.0).astype(jnp.int32)
+    init = (avail, count, acc0, jnp.int32(0), False)
+    avail, _, acc, _, _ = jax.lax.while_loop(cond, body, init)
+    return avail, acc
+
+
+def _sharded_body(avail, total, alive, demands, counts, thr, max_passes,
+                  axis_name):
+    def step(avail, xs):
+        d, count = xs
+        avail, acc = _one_class_sharded(
+            avail, total, alive, d, count, thr, max_passes, axis_name
+        )
+        return avail, acc
+
+    new_avail, assigned = jax.lax.scan(
+        step, avail.astype(jnp.float32), (demands, counts)
+    )
+    return assigned, new_avail
+
+
+def make_sharded_scheduler(mesh: Mesh, axis: str = "nodes",
+                           max_passes: int = MAX_PASSES):
+    """Build a jitted sharded kernel over `mesh`'s `axis`.
+
+    Returns fn(avail [N,R], total [N,R], alive [N], demands [C,R],
+    counts [C], thr) -> (assigned [C,N] int32, new_avail [N,R]); N must
+    divide by the axis size; inputs may be host arrays (jit shards them
+    per the in_shardings)."""
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+    node_sharded = NamedSharding(mesh, P(axis))
+    replicated = NamedSharding(mesh, P())
+
+    def _block(avail, total, alive, demands, counts, thr):
+        return _sharded_body(
+            avail, total, alive, demands, counts, thr, max_passes, axis
+        )
+
+    body = shard_map(
+        _block,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis), P(), P(), P()),
+        out_specs=(P(None, axis), P(axis)),
+    )
+
+    @functools.partial(
+        jax.jit,
+        in_shardings=(node_sharded, node_sharded, node_sharded,
+                      replicated, replicated, replicated),
+        out_shardings=(replicated, node_sharded),
+    )
+    def run(avail, total, alive, demands, counts, thr):
+        return body(avail, total, alive, demands, counts, thr)
+
+    def fn(avail, total, alive, demands, counts,
+           thr=0.5) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        return run(
+            jnp.asarray(avail, jnp.float32),
+            jnp.asarray(total, jnp.float32),
+            jnp.asarray(alive),
+            jnp.asarray(demands, jnp.float32),
+            jnp.asarray(counts, jnp.int32),
+            jnp.float32(thr),
+        )
+
+    return fn

@@ -1,0 +1,102 @@
+"""Sharded scheduling kernel: golden equality on the 8-device CPU mesh.
+
+The north star's "under pmap" clause (BASELINE.json config 5): the
+cluster matrix shards over the mesh's node axis and decisions must stay
+EXACTLY equal to the single-device kernel (and therefore to the NumPy
+twin, whose equality is already golden-tested)."""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh
+
+from ray_tpu.sched import kernel_jax, kernel_np
+from ray_tpu.sched.kernel_shard import make_sharded_scheduler
+
+
+def _mesh():
+    devs = np.array(jax.devices())
+    if len(devs) < 2:
+        pytest.skip("needs the multi-device CPU mesh")
+    return Mesh(devs, ("nodes",))
+
+
+def _problem(rng, n_nodes, n_classes, dense=False):
+    R = 16
+    total = np.zeros((n_nodes, R), np.float32)
+    total[:, 0] = rng.integers(4, 65, n_nodes)
+    total[:, 3] = rng.integers(16, 257, n_nodes)
+    if not dense:
+        total[:, 2] = np.where(rng.random(n_nodes) < 0.3, 8.0, 0.0)
+    alive = rng.random(n_nodes) < 0.95
+    demands = np.zeros((n_classes, R), np.float32)
+    demands[:, 0] = rng.integers(1, 5, n_classes)
+    mem = rng.random(n_classes) < 0.5
+    demands[mem, 3] = rng.integers(1, 9, mem.sum())
+    tpu = rng.random(n_classes) < 0.2
+    demands[tpu, 2] = rng.integers(1, 3, tpu.sum())
+    counts = rng.integers(0, 200, n_classes).astype(np.int32)
+    return total, alive, demands, counts
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_sharded_matches_single_device(seed):
+    mesh = _mesh()
+    p = len(mesh.devices.ravel())
+    rng = np.random.default_rng(seed)
+    n_nodes = 64 * p  # divisible by the mesh axis
+    total, alive, demands, counts = _problem(rng, n_nodes, n_classes=24)
+    avail = (total * alive[:, None]).astype(np.float32)
+
+    fn = make_sharded_scheduler(mesh)
+    a_sh, na_sh = fn(avail, total, alive, demands, counts, 0.5)
+    a_1d, na_1d = kernel_jax.schedule_classes(
+        avail, total, alive, demands, counts, 0.5
+    )
+    np.testing.assert_array_equal(np.asarray(a_sh), np.asarray(a_1d))
+    np.testing.assert_allclose(
+        np.asarray(na_sh), np.asarray(na_1d), atol=1e-4
+    )
+
+
+def test_sharded_matches_numpy_twin():
+    """Transitively the strongest guarantee: mesh-sharded decisions equal
+    the int64 NumPy reference."""
+    mesh = _mesh()
+    p = len(mesh.devices.ravel())
+    rng = np.random.default_rng(7)
+    total, alive, demands, counts = _problem(rng, 32 * p, n_classes=12)
+    avail = (total * alive[:, None]).astype(np.float32)
+
+    fn = make_sharded_scheduler(mesh)
+    a_sh, _ = fn(avail, total, alive, demands, counts, 0.5)
+    a_np, _ = kernel_np.schedule_classes(
+        avail.copy(), total, alive, demands, counts, spread_threshold=0.5
+    )
+    np.testing.assert_array_equal(np.asarray(a_sh), a_np)
+
+
+def test_sharded_carried_state_rounds():
+    """Multi-round stream with carried-over sharded availability: the
+    device-resident new_avail feeds the next round directly (no host
+    round trip) and stays equal to the single-device path."""
+    mesh = _mesh()
+    p = len(mesh.devices.ravel())
+    rng = np.random.default_rng(11)
+    total, alive, demands, counts = _problem(rng, 32 * p, n_classes=8)
+    fn = make_sharded_scheduler(mesh)
+
+    av_sh = (total * alive[:, None]).astype(np.float32)
+    av_1d = av_sh.copy()
+    for rnd in range(4):
+        k = np.maximum(counts - rnd * 30, 0).astype(np.int32)
+        a_sh, av_sh = fn(av_sh, total, alive, demands, k, 0.5)
+        a_1d, av_1d = kernel_jax.schedule_classes(
+            av_1d, total, alive, demands, k, 0.5
+        )
+        np.testing.assert_array_equal(
+            np.asarray(a_sh), np.asarray(a_1d), err_msg=f"round {rnd}"
+        )
+        av_sh = np.asarray(av_sh)
+        av_1d = np.asarray(av_1d)
