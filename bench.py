@@ -247,6 +247,26 @@ def config_5(dev):
     sched = JaxScheduler(total, alive, device=dev)
     sched.set_available(total * alive[:, None])
 
+    # warm every program the stream can hit: the kernel, each sparse-
+    # download nonzero cap bucket in BOTH value dtypes (max(counts)<256
+    # selects uint8, otherwise int32 — a skewed round can pair a small
+    # bucket with the wide dtype), and the dense fallback (backlog above
+    # the largest cap). First compiles go through the remote compile
+    # service at 10-40s each and must not be billed to steady-state round
+    # time. Each warm round is fetched; the availability reset below
+    # discards its placements.
+    C = len(counts)
+    for target in (800, 3_000, 12_000, 50_000, 150_000):
+        kw = np.minimum(counts, max(target // C, 1)).astype(np.int32)
+        sched.fetch(sched.schedule_async(demands, kw, algo=ALGO))
+        if kw.max() < 256:  # same bucket, wide-dtype variant
+            kw2 = np.zeros_like(kw)
+            kw2[0] = min(target, 2_000_000)
+            sched.fetch(sched.schedule_async(demands, kw2, algo=ALGO))
+    dense = np.full(C, 300_000 // C + 1, np.int32)  # above the last cap
+    sched.fetch(sched.schedule_async(demands, dense, algo=ALGO))
+    sched.set_available(total * alive[:, None])
+
     # host mirror of device availability, for the standing TPU-numerics
     # invariant guard (see kernel_jax docstring): placements must never
     # exceed what is actually free
@@ -257,12 +277,41 @@ def config_5(dev):
     arrivals.append((counts - np.sum(arrivals, axis=0)).astype(np.int32))
     backlog = np.zeros_like(counts)
     inflight = []  # (complete_round, assigned[C, N])
-    sched_times = []
+    # PIPELINED rounds (JaxScheduler.schedule_async/fetch): rounds are
+    # enqueued against the device-resident availability and forced with a
+    # lag, so link latency amortizes across the window instead of being
+    # paid per round — the live-GCS hot path uses the identical mechanism
+    # (HybridPolicy.schedule_pipelined). Per-class in-flight counts gate
+    # resubmission (a task is never scheduled twice while its round is in
+    # flight).
+    import os as _os
+    PIPE_DEPTH = int(_os.environ.get("RAY_TPU_BENCH_PIPE_DEPTH", "6"))
+    pipe = []  # (handle, submitted_counts)
+    inflight_counts = np.zeros_like(backlog)
+    sched_times = []  # end-to-end wall per loop iteration with work
     total_decisions = 0
     scaled_up_at = None
 
+    def fetch_oldest():
+        nonlocal host_avail, backlog, inflight_counts, total_decisions
+        handle, submitted = pipe.pop(0)
+        assigned = sched.fetch(handle)
+        placed_c = assigned.sum(axis=1).astype(np.int32)
+        assert (placed_c <= submitted).all(), "stream overplaced a class"
+        used_round = assigned.astype(np.float32).T @ demands
+        assert (used_round <= host_avail + 1e-2).all(), \
+            "stream exceeded capacity"
+        host_avail = np.maximum(host_avail - used_round, 0.0)
+        backlog = backlog - placed_c
+        inflight_counts = inflight_counts - submitted
+        total_decisions += int(placed_c.sum())
+        if placed_c.sum() > 0:
+            inflight.append((rnd + 2, assigned))
+
     rnd = 0
-    while rnd < len(arrivals) or backlog.sum() > 0 or inflight:
+    t_stream0 = time.perf_counter()
+    while rnd < len(arrivals) or backlog.sum() > 0 or inflight or pipe:
+        t_round0 = time.perf_counter()
         # completions release resources (carried-over state, incremental)
         due = [a for r0, a in inflight if r0 <= rnd]
         inflight = [(r0, a) for r0, a in inflight if r0 > rnd]
@@ -285,24 +334,25 @@ def config_5(dev):
             sched.update_rows(idx, total[idx])
             host_avail[idx] = total[idx]
             scaled_up_at = rnd
-        if backlog.sum() > 0:
-            t0 = time.perf_counter()
-            assigned = sched.schedule(demands, backlog, algo=ALGO)
-            sched_times.append(time.perf_counter() - t0)
-            placed_c = assigned.sum(axis=1).astype(np.int32)
-            assert (placed_c <= backlog).all(), "stream overplaced a class"
-            used_round = assigned.astype(np.float32).T @ demands
-            assert (used_round <= host_avail + 1e-2).all(), \
-                "stream exceeded capacity"
-            host_avail = np.maximum(host_avail - used_round, 0.0)
-            backlog = backlog - placed_c
-            total_decisions += int(placed_c.sum())
-            if placed_c.sum() > 0:
-                inflight.append((rnd + 2, assigned))
+        submit = np.maximum(backlog - inflight_counts, 0).astype(np.int32)
+        did_work = False
+        if submit.sum() > 0:
+            pipe.append((
+                sched.schedule_async(demands, submit, algo=ALGO), submit,
+            ))
+            inflight_counts = inflight_counts + submit
+            did_work = True
+        if pipe and (len(pipe) > PIPE_DEPTH or submit.sum() == 0):
+            # window full (or nothing new to enqueue): force the oldest
+            # round; everything younger keeps computing/transferring
+            fetch_oldest()
+            did_work = True
+        if did_work:
+            sched_times.append(time.perf_counter() - t_round0)
         rnd += 1
-        if rnd > 200:
+        if rnd > 250:
             break
-    t_sched = float(np.sum(sched_times))
+    t_sched = time.perf_counter() - t_stream0
     # on-DEVICE round time, separated from the host link: round_ms_median
     # includes the decision download (narrow-dtype, but the axon tunnel has
     # been measured as low as ~35 MB/s), which direct-attached TPU hardware
@@ -345,10 +395,47 @@ def config_5(dev):
         a.block_until_ready()
         na.block_until_ready()
         dev_times.append(time.perf_counter() - t0)
+    # chained device rounds with ONE trailing sync: amortizes per-dispatch
+    # link overhead out of the measurement, so this approximates the pure
+    # on-device round (the number a direct-attached chip would deliver;
+    # the single-round block_until_ready above still carries ~a full
+    # tunnel round trip inside it)
+    kks = [
+        jax.device_put(
+            jnp.asarray(np.maximum(k + j, 0).astype(np.int32)), dev
+        )
+        for j in range(8)
+    ]
+    t0 = time.perf_counter()
+    outs = [run_kernel(kk)[0] for kk in kks]
+    outs[-1].block_until_ready()
+    chained_ms = (time.perf_counter() - t0) / len(kks) * 1e3
+
+    # link decomposition (the <50ms/round clause is judged against this):
+    # measured device->host throughput on the round's own assignment
+    # payload. End-to-end round time ~= device round + payload/link (the
+    # pipeline overlaps them across rounds; a degraded axon tunnel has
+    # measured as low as ~35 MB/s where direct-attached PCIe does GB/s).
+    link_ts = []
+    for i in range(3):
+        a8 = (a + i).astype(jnp.uint8)  # fresh array: defeat the host
+        a8.block_until_ready()          # copy cache on jax Arrays
+        t0 = time.perf_counter()
+        np.asarray(a8)
+        link_ts.append(time.perf_counter() - t0)
+    bytes_down = int(np.prod(a8.shape))
+    link_mbps = bytes_down / max(float(np.median(link_ts)), 1e-9) / 1e6
     return {
         "rounds": len(sched_times),
         "round_ms_median": round(float(np.median(sched_times)) * 1e3, 1),
         "round_ms_device": round(float(np.median(dev_times[1:])) * 1e3, 1),
+        "round_ms_device_chained": round(chained_ms, 1),
+        # dense-equivalent payload; the stream itself downloads SPARSE
+        # (COO) assignments, ~5 bytes/placement vs one byte/cell
+        "round_payload_dense_mb": round(bytes_down / 1e6, 2),
+        "sparse_download": True,
+        "link_down_mbps": round(link_mbps, 1),
+        "pipeline_depth": PIPE_DEPTH,
         "decisions": total_decisions,
         "decisions_per_sec": round(total_decisions / t_sched, 1),
         "autoscaled_at_round": scaled_up_at,
@@ -359,7 +446,7 @@ def config_5(dev):
 
 
 def gcs_loop_bench(policy_name, n_tasks=20_000, n_nodes=64,
-                   min_cells=None, n_classes=4):
+                   min_cells=None, n_classes=4, time_budget_s=150.0):
     """End-to-end decisions/s through a live GcsServer: submit via rpc,
     schedule via _schedule_round, drain completions between rounds.
 
@@ -399,7 +486,8 @@ def gcs_loop_bench(policy_name, n_tasks=20_000, n_nodes=64,
         t_submit = time.perf_counter() - t0
         t0 = time.perf_counter()
         placements = run_rounds_to_quiescence(
-            gcs, max_rounds=2000, drain_fraction=1.0
+            gcs, max_rounds=2000, drain_fraction=1.0,
+            time_budget_s=time_budget_s,
         )
         t_sched = time.perf_counter() - t0
         return {
@@ -407,6 +495,8 @@ def gcs_loop_bench(policy_name, n_tasks=20_000, n_nodes=64,
             "placed": len(placements),
             "submit_per_sec": round(n_tasks / t_submit, 1),
             "decisions_per_sec": round(len(placements) / t_sched, 1),
+            # budget-capped runs report throughput over what completed
+            "budget_hit": len(placements) < n_tasks,
         }
     finally:
         gcs.shutdown()
@@ -477,6 +567,77 @@ def cluster_mode_bench(n_nodes=4, cpus_per_node=8, n_tasks=2000):
                 p.wait(timeout=5)
             except Exception:
                 p.kill()
+
+
+def sharded_kernel_bench():
+    """Sharded-kernel validation line (north star: "under pmap"): run the
+    node-axis shard_map kernel on the virtual 8-device CPU mesh in a
+    SUBPROCESS (this process owns the TPU platform), assert decision
+    equality with the single-device kernel, and report both round times.
+    The CPU-mesh timing validates the sharding's correctness and
+    collective structure, not TPU speed (one real chip here)."""
+    import subprocess
+
+    code = r"""
+import os, time, json
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+    " --xla_force_host_platform_device_count=8"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from jax.sharding import Mesh
+from ray_tpu.sched import kernel_jax
+from ray_tpu.sched.kernel_shard import make_sharded_scheduler
+
+rng = np.random.default_rng(0)
+N, C, R = 2048, 32, 16
+total = np.zeros((N, R), np.float32)
+total[:, 0] = rng.integers(16, 65, N)
+total[:, 3] = rng.integers(64, 513, N)
+alive = np.ones(N, bool)
+demands = np.zeros((C, R), np.float32)
+demands[:, 0] = rng.integers(1, 5, C)
+counts = rng.integers(0, 500, C).astype(np.int32)
+avail = total.copy()
+
+mesh = Mesh(np.array(jax.devices()), ("nodes",))
+fn = make_sharded_scheduler(mesh)
+a_sh, _ = fn(avail, total, alive, demands, counts, 0.5)  # compile
+a_1d, _ = kernel_jax.schedule_classes(avail, total, alive, demands, counts, 0.5)
+equal = bool((np.asarray(a_sh) == np.asarray(a_1d)).all())
+
+def t(f):
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        a, na = f()
+        a.block_until_ready()
+        ts.append(time.perf_counter() - t0)
+    return round(float(np.median(ts)) * 1e3, 1)
+
+ms_sh = t(lambda: fn(avail, total, alive, demands, counts, 0.5))
+ms_1d = t(lambda: kernel_jax.schedule_classes(
+    avail, total, alive, demands, counts, 0.5))
+print(json.dumps({
+    "devices": len(jax.devices()),
+    "decisions_equal_single_device": equal,
+    "placed": int(np.asarray(a_sh).sum()),
+    "round_ms_sharded_cpu_mesh": ms_sh,
+    "round_ms_single_cpu": ms_1d,
+}))
+"""
+    import os
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__)) + \
+        os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=600, env=env,
+    )
+    if r.returncode != 0:
+        return {"error": r.stderr.strip()[-500:]}
+    return json.loads(r.stdout.strip().splitlines()[-1])
 
 
 def _tpu_available(timeout_s: float = 120.0) -> bool:
@@ -560,6 +721,11 @@ def main():
         "jax_tpu", n_tasks=20_000, n_nodes=4096, n_classes=64
     )
     log(f"gcs jax device {configs['gcs_loop_jax_device']} ({time.time()-t0:.1f}s)")
+
+    t0 = time.time()
+    configs["sharded_kernel_8dev_cpu"] = sharded_kernel_bench()
+    log(f"sharded kernel {configs['sharded_kernel_8dev_cpu']} "
+        f"({time.time()-t0:.1f}s)")
 
     t0 = time.time()
     configs["cluster_mode"] = cluster_mode_bench()
