@@ -373,7 +373,15 @@ def _execute(client: RpcClient, t: dict):
                 shm_results.append((oid, len(data)))
             else:
                 payloads[oid] = data
-        client.call("task_finished", {
+        # Fire-and-forget: profiling showed the worker blocked ~20ms per
+        # task awaiting this ack on a loaded single-core host — 10x the
+        # task's actual CPU cost. TCP keeps the frame ordered and reliable
+        # on a live connection; if the connection dies instead, on_close
+        # exits this worker and the daemon resolves the task as
+        # WORKER_DIED — the same recovery the blocking path had. The
+        # exception: tasks that REPORT BORROWS keep the blocking ack, so
+        # the borrow registry is in place before this worker's pins drop.
+        payload = {
             "task_id": task_id,
             "status": status,
             "error": error,
@@ -382,7 +390,11 @@ def _execute(client: RpcClient, t: dict):
             "borrows": borrows,
             "start": start,
             "end": time.time(),
-        }, timeout=120.0)
+        }
+        if borrows:
+            client.call("task_finished", payload, timeout=120.0)
+        else:
+            client.notify("task_finished", payload)
     finally:
         # leaked pins would make the objects permanently unevictable
         for oid in pins:
@@ -430,6 +442,14 @@ def main():  # pragma: no cover - runs as a subprocess
             traceback.print_exc()
             os._exit(1)
 
+    profiler = None
+    n_profiled = 0
+    if os.environ.get("RAY_TPU_WORKER_PROFILE"):
+        import cProfile
+
+        import time as _t
+        profiler = cProfile.Profile(_t.process_time)  # CPU, not wall
+
     pool = None
     while True:
         t = tasks.get()
@@ -440,6 +460,16 @@ def main():  # pragma: no cover - runs as a subprocess
                 # worker process, so one pool)
                 pool = ThreadPoolExecutor(max_workers=mc)
             pool.submit(_pooled, t)
+        elif profiler is not None:
+            profiler.enable()
+            _execute(client, t)
+            profiler.disable()
+            n_profiled += 1
+            if n_profiled % 100 == 0:  # workers die via os._exit: no atexit
+                profiler.dump_stats(
+                    f"{os.environ['RAY_TPU_WORKER_PROFILE']}"
+                    f".{os.getpid()}"
+                )
         else:
             _execute(client, t)
 
