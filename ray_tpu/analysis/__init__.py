@@ -1,0 +1,28 @@
+"""ray_tpu.analysis — distributed-correctness linter + concurrency sanitizer.
+
+Static half: ``python -m ray_tpu.analysis <paths>`` runs the AST checkers
+registered in :mod:`ray_tpu.analysis.checkers` (blocking-in-async,
+unsafe-closure-capture, lock-order-cycle, unawaited-coroutine,
+dropped-object-ref, resource-spec-validation) with per-line
+``# ray-lint: disable=<check>`` pragmas and a committed ratchet baseline.
+
+Runtime half: :class:`ray_tpu.analysis.sanitizer.LockOrderSanitizer`, an
+instrumented-lock shim recording observed lock orderings (opt in from
+tests via the ``lock_sanitizer`` fixture) to cross-check the static graph.
+
+Deliberately imports no runtime module (jax, numpy, the cluster stack):
+linting must work in any environment the source parses in.
+"""
+
+from ray_tpu.analysis.core import (  # noqa: F401
+    CHECKERS,
+    AnalysisResult,
+    Checker,
+    Finding,
+    ModuleContext,
+    analyze_paths,
+    load_baseline,
+    register,
+    split_by_baseline,
+    write_baseline,
+)

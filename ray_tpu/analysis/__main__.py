@@ -1,0 +1,127 @@
+"""CLI: ``python -m ray_tpu.analysis <paths> [options]``.
+
+Exit codes: 0 = clean (or every finding baselined), 1 = new findings,
+2 = usage/parse errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from ray_tpu.analysis.core import (
+    CHECKERS,
+    analyze_paths,
+    load_baseline,
+    split_by_baseline,
+    write_baseline,
+)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m ray_tpu.analysis",
+        description="ray_tpu distributed-correctness linter",
+    )
+    p.add_argument("paths", nargs="*", default=["ray_tpu"],
+                   help="files/directories to scan (default: ray_tpu)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--baseline", default=None, metavar="FILE",
+                   help="JSON ratchet baseline; findings whose fingerprint "
+                        "appears there are reported but don't fail")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite --baseline with the current findings")
+    p.add_argument("--select", default=None, metavar="CHECKS",
+                   help="comma-separated subset of checks to run")
+    p.add_argument("--list-checks", action="store_true")
+    args = p.parse_args(argv)
+
+    # Import for side effect: populate the registry before --list-checks.
+    from ray_tpu.analysis import checkers as _checkers  # noqa: F401
+
+    if args.list_checks:
+        for name in sorted(CHECKERS):
+            print(f"{name}: {CHECKERS[name].description}")
+        return 0
+
+    select = (
+        [s.strip() for s in args.select.split(",") if s.strip()]
+        if args.select
+        else None
+    )
+    if select and args.update_baseline:
+        # A partial-check scan would rewrite the baseline without the
+        # unselected checks' entries, re-firing them as "new" later.
+        print("error: --update-baseline cannot be combined with --select",
+              file=sys.stderr)
+        return 2
+    paths = [p_ for p_ in args.paths if os.path.exists(p_)]
+    missing = [p_ for p_ in args.paths if not os.path.exists(p_)]
+    if missing or not paths:
+        print(f"error: no such path(s): {missing}", file=sys.stderr)
+        return 2
+    # Fingerprints hash Finding.path, so anchor relpaths to the baseline
+    # file's directory: the baseline then works from any cwd.
+    root = (
+        os.path.dirname(os.path.abspath(args.baseline))
+        if args.baseline
+        else None
+    )
+    try:
+        result = analyze_paths(paths, root=root, select=select)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    if args.update_baseline:
+        if not args.baseline:
+            print("error: --update-baseline requires --baseline",
+                  file=sys.stderr)
+            return 2
+        if result.errors:
+            # Refuse to write a baseline from a partial scan: findings in
+            # the unparseable files would later surface as "new".
+            for e in result.errors:
+                print(f"parse error: {e}", file=sys.stderr)
+            print("error: not updating baseline from a partial scan",
+                  file=sys.stderr)
+            return 2
+        write_baseline(args.baseline, result.findings)
+        print(f"wrote {len(result.findings)} finding(s) to {args.baseline}")
+        return 0
+
+    baseline = load_baseline(args.baseline) if args.baseline else {}
+    new, known = split_by_baseline(result.findings, baseline)
+
+    if args.format == "json":
+        print(json.dumps(
+            {
+                "new": [f.to_dict() for f in new],
+                "baselined": [f.to_dict() for f in known],
+                "suppressed": result.suppressed,
+                "files_scanned": result.files_scanned,
+                "errors": result.errors,
+                "checks": sorted(select or CHECKERS),
+            },
+            indent=2,
+        ))
+    else:
+        for f in new:
+            print(f.format())
+        for f in known:
+            print(f"{f.format()}  (baselined)")
+        for e in result.errors:
+            print(f"parse error: {e}", file=sys.stderr)
+        print(
+            f"{result.files_scanned} file(s) scanned: {len(new)} new, "
+            f"{len(known)} baselined, {result.suppressed} suppressed"
+        )
+    if result.errors:
+        return 2
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,1077 @@
+"""Distributed-correctness checkers (the plugin table).
+
+Each checker is a small AST visitor registered via ``@register``:
+
+- ``blocking-in-async``     blocking calls on an asyncio event loop
+- ``unsafe-closure-capture`` remote closures capturing unserializable state
+- ``lock-order-cycle``      cycles in the static lock-acquisition graph
+- ``unawaited-coroutine``   coroutine created and never awaited
+- ``dropped-object-ref``    ``.remote()`` result discarded (lost task/error)
+- ``resource-spec-validation`` task/actor resource requests the scheduler
+                            layer can never satisfy
+
+The lock graph and resource-name registry are whole-program: they
+accumulate across ``check_module`` calls and report from ``finalize``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ray_tpu.analysis.core import (
+    Checker,
+    Finding,
+    ModuleContext,
+    find_cycles,
+    register,
+)
+
+# ------------------------------------------------------------------- utilities
+
+
+class ImportMap:
+    """alias -> canonical dotted prefix, from a module's import statements."""
+
+    def __init__(self, tree: ast.AST):
+        self.modules: Dict[str, str] = {}  # "np" -> "numpy"
+        self.names: Dict[str, str] = {}  # "sleep" -> "time.sleep"
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        self.modules[a.asname] = a.name
+                    else:
+                        # `import a.b` binds only `a`, and an attribute
+                        # chain through it already spells the full dotted
+                        # path — mapping `a -> a.b` would double-expand
+                        # (`concurrent.futures.futures.…`).
+                        top = a.name.split(".")[0]
+                        self.modules[top] = top
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    self.names[a.asname or a.name] = f"{node.module}.{a.name}"
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Canonical dotted name for a Name/Attribute chain, resolving
+        top-level import aliases; None for non-name expressions."""
+        parts: List[str] = []
+        cur = node
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        if not isinstance(cur, ast.Name):
+            return None
+        base = cur.id
+        parts.append(base)
+        parts.reverse()
+        if base in self.names:
+            parts[0:1] = self.names[base].split(".")
+        elif base in self.modules:
+            parts[0:1] = self.modules[base].split(".")
+        return ".".join(parts)
+
+
+def _is_remote_decorator(dec: ast.AST) -> bool:
+    """Matches @remote, @ray_tpu.remote, @<alias>.remote, and the
+    argument-taking forms @remote(...), @ray_tpu.remote(...)."""
+    if isinstance(dec, ast.Call):
+        dec = dec.func
+    if isinstance(dec, ast.Name):
+        return dec.id == "remote"
+    if isinstance(dec, ast.Attribute):
+        return dec.attr == "remote"
+    return False
+
+
+def _remote_decorator_calls(node) -> List[ast.Call]:
+    return [
+        d for d in getattr(node, "decorator_list", [])
+        if isinstance(d, ast.Call) and _is_remote_decorator(d)
+    ]
+
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+# ------------------------------------------------------------ blocking-in-async
+
+# Calls that block the calling OS thread; on an event loop they stall every
+# other coroutine sharing that loop (reference: Ray's asyncio-actor docs ban
+# exactly these inside async actor methods).
+_BLOCKING_CALLS: Dict[str, str] = {
+    "time.sleep": "use `await asyncio.sleep(...)`",
+    "subprocess.run": "use `asyncio.create_subprocess_exec` or an executor",
+    "subprocess.call": "use `asyncio.create_subprocess_exec` or an executor",
+    "subprocess.check_call": "use an executor",
+    "subprocess.check_output": "use an executor",
+    "os.system": "use an executor",
+    "socket.create_connection": "use `asyncio.open_connection`",
+    "requests.get": "use an executor or async client",
+    "requests.post": "use an executor or async client",
+    "ray_tpu.get": "blocking driver API stalls the loop; "
+    "use `asyncio.wrap_future`/an executor or restructure",
+    "ray_tpu.wait": "blocking driver API stalls the loop; use an executor",
+}
+
+# Constructors whose instances have thread-blocking methods worth tracking
+# when bound to locals inside the async function.
+_BLOCKING_CTORS: Dict[str, Set[str]] = {
+    "queue.Queue": {"get", "put", "join"},
+    "queue.SimpleQueue": {"get", "put"},
+    "threading.Lock": {"acquire"},
+    "threading.RLock": {"acquire"},
+    "threading.Event": {"wait"},
+    "threading.Condition": {"wait", "acquire", "wait_for"},
+    "threading.Semaphore": {"acquire"},
+    "threading.Thread": {"join"},
+}
+
+
+def _walk_body(fn):
+    """Yield nodes executing in fn's own frame: skips nested defs/lambdas
+    (they run elsewhere, or are separate bodies visited on their own)."""
+
+    def gen(node, top):
+        if not top and isinstance(node, _FUNC_NODES + (ast.Lambda,)):
+            return
+        yield node
+        for child in ast.iter_child_nodes(node):
+            yield from gen(child, False)
+
+    yield from gen(fn, True)
+
+
+def _class_lock_attrs(cls: ast.ClassDef, imports: "ImportMap") -> Dict[str, str]:
+    """{attr: ctor} for `self.X = threading.Lock()/RLock()/Condition()`."""
+    out: Dict[str, str] = {}
+    for sub in ast.walk(cls):
+        if isinstance(sub, ast.Assign) and isinstance(sub.value, ast.Call):
+            ctor = imports.resolve(sub.value.func)
+            if ctor in _LOCK_CTORS:
+                for tgt in sub.targets:
+                    if (
+                        isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"
+                    ):
+                        out[tgt.attr] = ctor
+    return out
+
+
+@register
+class BlockingInAsyncChecker(Checker):
+    name = "blocking-in-async"
+    description = (
+        "thread-blocking call on an event loop: inside an `async def` "
+        "body, a sync function it (transitively) calls, or a sync method "
+        "of an async actor (those run ON the actor's loop thread)"
+    )
+
+    def check_module(self, ctx: ModuleContext) -> List[Finding]:
+        imports = ImportMap(ctx.tree)
+        out: List[Finding] = []
+        blockers = self._module_blockers(ctx.tree, imports)
+
+        for node in ctx.tree.body:
+            if isinstance(node, ast.AsyncFunctionDef):
+                self._check_on_loop_body(
+                    node, imports, ctx, out, blockers, lock_attrs={},
+                    cls=None,
+                )
+            elif isinstance(node, ast.ClassDef):
+                lock_attrs = _class_lock_attrs(node, imports)
+                has_async = any(
+                    isinstance(m, ast.AsyncFunctionDef) for m in node.body
+                )
+                is_remote = any(
+                    _is_remote_decorator(d) for d in node.decorator_list
+                )
+                # Methods handed to threading.Thread(target=self.X) run on
+                # their own OS thread, not the actor loop — exempt from the
+                # sync-method-on-loop rule.
+                thread_targets: Set[str] = set()
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Call):
+                        for kw in sub.keywords:
+                            if (
+                                kw.arg == "target"
+                                and isinstance(kw.value, ast.Attribute)
+                                and isinstance(kw.value.value, ast.Name)
+                                and kw.value.value.id == "self"
+                            ):
+                                thread_targets.add(kw.value.attr)
+                for m in node.body:
+                    if isinstance(m, ast.AsyncFunctionDef):
+                        self._check_on_loop_body(
+                            m, imports, ctx, out, blockers, lock_attrs,
+                            cls=node.name,
+                        )
+                    elif (
+                        isinstance(m, ast.FunctionDef)
+                        and has_async
+                        and is_remote
+                        and not m.name.startswith("__")
+                        and m.name not in thread_targets
+                    ):
+                        # Async-actor contract: sync methods of an async
+                        # actor execute ON the loop thread too.
+                        self._check_on_loop_body(
+                            m, imports, ctx, out, blockers, lock_attrs,
+                            cls=node.name, sync_on_loop=True,
+                        )
+        # Nested async defs anywhere (e.g. inside sync helpers).
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.AsyncFunctionDef) and not any(
+                node is t or (isinstance(t, ast.ClassDef) and node in t.body)
+                for t in ctx.tree.body
+            ):
+                self._check_on_loop_body(
+                    node, imports, ctx, out, blockers, lock_attrs={},
+                    cls=None,
+                )
+        return out
+
+    # -- transitive "does this sync function/method block?" summaries
+
+    def _direct_reason(self, fn, imports) -> Optional[str]:
+        for sub in _walk_body(fn):
+            if isinstance(sub, ast.Call):
+                dotted = imports.resolve(sub.func)
+                if dotted in _BLOCKING_CALLS:
+                    return f"calls `{dotted}` at line {sub.lineno}"
+        return None
+
+    def _module_blockers(self, tree, imports) -> Dict[Tuple, str]:
+        """{(class or None, func name): reason} for sync defs that block,
+        propagated through same-module/same-class sync call chains."""
+        funcs: Dict[Tuple, ast.AST] = {}
+        for node in tree.body:
+            if isinstance(node, ast.FunctionDef):
+                funcs[(None, node.name)] = node
+            elif isinstance(node, ast.ClassDef):
+                for m in node.body:
+                    if isinstance(m, ast.FunctionDef):
+                        funcs[(node.name, m.name)] = m
+        reasons: Dict[Tuple, str] = {}
+        for key, fn in funcs.items():
+            r = self._direct_reason(fn, imports)
+            if r:
+                reasons[key] = r
+        changed = True
+        while changed:
+            changed = False
+            for key, fn in funcs.items():
+                if key in reasons:
+                    continue
+                cls = key[0]
+                for sub in _walk_body(fn):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    callee = None
+                    f = sub.func
+                    if isinstance(f, ast.Name) and (None, f.id) in reasons:
+                        callee = (None, f.id)
+                    elif (
+                        isinstance(f, ast.Attribute)
+                        and isinstance(f.value, ast.Name)
+                        and f.value.id == "self"
+                        and (cls, f.attr) in reasons
+                    ):
+                        callee = (cls, f.attr)
+                    if callee:
+                        reasons[key] = (
+                            f"calls `{'.'.join(filter(None, callee))}` "
+                            f"which {reasons[callee]}"
+                        )
+                        changed = True
+                        break
+        return reasons
+
+    # -- per-body check
+
+    def _check_on_loop_body(
+        self, fn, imports, ctx, out, blockers, lock_attrs, cls,
+        sync_on_loop=False,
+    ):
+        where = (
+            f"`async def {fn.name}`"
+            if not sync_on_loop
+            else f"sync method `{fn.name}` of async actor `{cls}` "
+            "(runs on the actor event loop)"
+        )
+        local_ctors: Dict[str, str] = {}
+        awaited: Set[int] = set()
+        for node in _walk_body(fn):
+            if isinstance(node, ast.Await) and isinstance(node.value, ast.Call):
+                awaited.add(id(node.value))
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                ctor = imports.resolve(node.value.func)
+                if ctor in _BLOCKING_CTORS:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            local_ctors[tgt.id] = ctor
+            # threading lock/condition acquisition on the loop thread
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    e = item.context_expr
+                    if (
+                        isinstance(e, ast.Attribute)
+                        and isinstance(e.value, ast.Name)
+                        and e.value.id == "self"
+                        and e.attr in lock_attrs
+                    ):
+                        out.append(
+                            ctx.finding(
+                                e,
+                                self.name,
+                                f"`with self.{e.attr}` "
+                                f"({lock_attrs[e.attr]}) inside {where} "
+                                "blocks the event loop when contended; "
+                                "use asyncio primitives or confine the "
+                                "state to the loop thread",
+                            )
+                        )
+            if isinstance(node, ast.Call) and id(node) not in awaited:
+                self._check_call(
+                    node, imports, local_ctors, where, cls, blockers,
+                    lock_attrs, ctx, out,
+                )
+
+    def _check_call(
+        self, call, imports, local_ctors, where, cls, blockers, lock_attrs,
+        ctx, out,
+    ):
+        dotted = imports.resolve(call.func)
+        if dotted in _BLOCKING_CALLS:
+            out.append(
+                ctx.finding(
+                    call,
+                    self.name,
+                    f"blocking call `{dotted}` inside {where}; "
+                    f"{_BLOCKING_CALLS[dotted]}",
+                )
+            )
+            return
+        func = call.func
+        if not isinstance(func, ast.Attribute):
+            # sync same-module function that (transitively) blocks
+            if isinstance(func, ast.Name) and (None, func.id) in blockers:
+                out.append(
+                    ctx.finding(
+                        call,
+                        self.name,
+                        f"call to `{func.id}` inside {where} blocks: "
+                        f"{blockers[(None, func.id)]}",
+                    )
+                )
+            return
+        # self.<m>() where m (transitively) blocks
+        if (
+            isinstance(func.value, ast.Name)
+            and func.value.id == "self"
+            and (cls, func.attr) in blockers
+        ):
+            out.append(
+                ctx.finding(
+                    call,
+                    self.name,
+                    f"call to `self.{func.attr}` inside {where} blocks: "
+                    f"{blockers[(cls, func.attr)]}",
+                )
+            )
+            return
+        # Unawaited concurrent.futures-style join.
+        if func.attr == "result":
+            out.append(
+                ctx.finding(
+                    call,
+                    self.name,
+                    f"un-awaited `.result()` inside {where} blocks the "
+                    "event loop; await the future (or wrap with "
+                    "`asyncio.wrap_future`)",
+                )
+            )
+            return
+        # `self._lock.acquire()` on a class threading lock.
+        if (
+            func.attr in ("acquire", "wait", "wait_for")
+            and isinstance(func.value, ast.Attribute)
+            and isinstance(func.value.value, ast.Name)
+            and func.value.value.id == "self"
+            and func.value.attr in lock_attrs
+        ):
+            out.append(
+                ctx.finding(
+                    call,
+                    self.name,
+                    f"blocking `self.{func.value.attr}.{func.attr}()` "
+                    f"({lock_attrs[func.value.attr]}) inside {where}; use "
+                    "asyncio primitives",
+                )
+            )
+            return
+        # Blocking method on a local bound to a known blocking ctor.
+        if isinstance(func.value, ast.Name):
+            ctor = local_ctors.get(func.value.id)
+            if ctor and func.attr in _BLOCKING_CTORS[ctor]:
+                out.append(
+                    ctx.finding(
+                        call,
+                        self.name,
+                        f"blocking `{func.value.id}.{func.attr}()` "
+                        f"({ctor}) inside {where}; use the asyncio "
+                        "equivalent",
+                    )
+                )
+
+
+# ------------------------------------------------------ unsafe-closure-capture
+
+# Constructors producing objects that cannot cross a serialization boundary
+# (cloudpickle refuses locks/sockets/files; device arrays must travel via
+# the object store, not closure bytes).
+_UNSERIALIZABLE_CTORS: Dict[str, str] = {
+    "threading.Lock": "lock",
+    "threading.RLock": "lock",
+    "threading.Condition": "condition",
+    "threading.Event": "event",
+    "threading.Semaphore": "semaphore",
+    "threading.BoundedSemaphore": "semaphore",
+    "asyncio.Lock": "asyncio lock",
+    "asyncio.Event": "asyncio event",
+    "asyncio.Condition": "asyncio condition",
+    "asyncio.Queue": "asyncio queue",
+    "socket.socket": "socket",
+    "socket.create_connection": "socket",
+    "open": "file handle",
+    "concurrent.futures.ThreadPoolExecutor": "thread pool",
+    "concurrent.futures.ProcessPoolExecutor": "process pool",
+    "jax.device_put": "device array",
+}
+
+
+@register
+class UnsafeClosureCaptureChecker(Checker):
+    name = "unsafe-closure-capture"
+    description = (
+        "@remote task/actor closure captures an unserializable object "
+        "(lock, socket, file handle, executor, device array) from an "
+        "enclosing function scope"
+    )
+
+    def check_module(self, ctx: ModuleContext) -> List[Finding]:
+        imports = ImportMap(ctx.tree)
+        out: List[Finding] = []
+        # Stack of enclosing-function binding maps: var -> ctor dotted name.
+        scopes: List[Dict[str, str]] = []
+
+        def visit(node):
+            if isinstance(node, _FUNC_NODES):
+                if scopes and any(
+                    _is_remote_decorator(d) for d in node.decorator_list
+                ):
+                    self._check_remote_closure(node, scopes, ctx, out)
+                # Own-frame bindings only: a sibling helper's local can
+                # never be captured by this function's nested closures.
+                bindings: Dict[str, str] = {}
+                for sub in _walk_body(node):
+                    if isinstance(sub, ast.Assign) and isinstance(
+                        sub.value, ast.Call
+                    ):
+                        ctor = imports.resolve(sub.value.func)
+                        if ctor in _UNSERIALIZABLE_CTORS:
+                            for tgt in sub.targets:
+                                if isinstance(tgt, ast.Name):
+                                    bindings[tgt.id] = ctor
+                scopes.append(bindings)
+                for child in ast.iter_child_nodes(node):
+                    visit(child)
+                scopes.pop()
+            else:
+                for child in ast.iter_child_nodes(node):
+                    visit(child)
+
+        visit(ctx.tree)
+        return out
+
+    def _check_remote_closure(self, fn, scopes, ctx, out):
+        local: Set[str] = {a.arg for a in fn.args.args}
+        local.update(a.arg for a in fn.args.kwonlyargs)
+        if fn.args.vararg:
+            local.add(fn.args.vararg.arg)
+        if fn.args.kwarg:
+            local.add(fn.args.kwarg.arg)
+        reported: Set[str] = set()
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Assign):
+                for tgt in sub.targets:
+                    if isinstance(tgt, ast.Name):
+                        local.add(tgt.id)
+            elif isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load):
+                name = sub.id
+                if name in local or name in reported:
+                    continue
+                for bindings in reversed(scopes):
+                    ctor = bindings.get(name)
+                    if ctor:
+                        reported.add(name)
+                        out.append(
+                            ctx.finding(
+                                sub,
+                                self.name,
+                                f"remote closure `{fn.name}` captures "
+                                f"`{name}` "
+                                f"({_UNSERIALIZABLE_CTORS[ctor]} from "
+                                f"`{ctor}`), which cannot serialize to a "
+                                "worker; pass state via args/ObjectRefs "
+                                "or create it inside the task",
+                            )
+                        )
+                        break
+
+
+# ------------------------------------------------------------- lock-order-cycle
+
+_LOCK_CTORS = {
+    "threading.Lock": "Lock",
+    "threading.RLock": "RLock",
+    "threading.Condition": "Condition",
+}
+
+
+@register
+class LockOrderCycleChecker(Checker):
+    name = "lock-order-cycle"
+    description = (
+        "cycle in the static lock-acquisition graph (`with a: with b:` in "
+        "one code path, `with b: with a:` in another)"
+    )
+
+    def __init__(self):
+        # node -> {"kind": Lock|RLock|Condition, "where": (path, line)}
+        self.nodes: Dict[str, Dict] = {}
+        # (src, dst) -> (path, line) of the inner acquisition
+        self.edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+        # Plain-Lock self-nesting is an immediate deadlock, found per-module.
+        self._module_findings: List[Finding] = []
+
+    # -- module pass: collect lock nodes, then acquisition orderings
+
+    def check_module(self, ctx: ModuleContext) -> List[Finding]:
+        imports = ImportMap(ctx.tree)
+        class_locks = self._collect_locks(ctx, imports)
+        self._module_findings = []
+        for node in ctx.tree.body:
+            if isinstance(node, ast.ClassDef):
+                self._walk_class(node, class_locks.get(node.name, {}), ctx)
+            elif isinstance(node, _FUNC_NODES):
+                self._walk_function(
+                    node, owner=None, locks=class_locks.get(None, {}),
+                    summaries={}, ctx=ctx,
+                )
+        return self._module_findings
+
+    def _collect_locks(self, ctx, imports) -> Dict[Optional[str], Dict[str, str]]:
+        """{class name (None = module level): {attr/var: node name}}."""
+        locks: Dict[Optional[str], Dict[str, str]] = {None: {}}
+        for node in ctx.tree.body:
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                ctor = imports.resolve(node.value.func)
+                if ctor in _LOCK_CTORS:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            nid = f"{ctx.modname}.{tgt.id}"
+                            locks[None][tgt.id] = nid
+                            self.nodes[nid] = {
+                                "kind": _LOCK_CTORS[ctor],
+                                "where": (ctx.relpath, node.lineno),
+                            }
+            elif isinstance(node, ast.ClassDef):
+                attrs: Dict[str, str] = {}
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Assign) and isinstance(
+                        sub.value, ast.Call
+                    ):
+                        ctor = imports.resolve(sub.value.func)
+                        if ctor not in _LOCK_CTORS:
+                            continue
+                        for tgt in sub.targets:
+                            if (
+                                isinstance(tgt, ast.Attribute)
+                                and isinstance(tgt.value, ast.Name)
+                                and tgt.value.id == "self"
+                            ):
+                                nid = f"{ctx.modname}.{node.name}.{tgt.attr}"
+                                attrs[tgt.attr] = nid
+                                self.nodes[nid] = {
+                                    "kind": _LOCK_CTORS[ctor],
+                                    "where": (ctx.relpath, sub.lineno),
+                                }
+                locks[node.name] = attrs
+        return locks
+
+    def _walk_class(self, cls: ast.ClassDef, locks: Dict[str, str], ctx):
+        # Method summaries: locks a method acquires anywhere inside, to
+        # propagate one interprocedural level (self.m() under a held lock).
+        methods = [n for n in cls.body if isinstance(n, _FUNC_NODES)]
+        direct: Dict[str, Set[str]] = {}
+        calls: Dict[str, Set[str]] = {}
+        for m in methods:
+            acq: Set[str] = set()
+            called: Set[str] = set()
+            for sub in ast.walk(m):
+                # Only true acquisitions count toward a method's summary:
+                # `with <lock>` items and bare `.acquire()` calls, not any
+                # mention of the attribute.
+                if isinstance(sub, ast.withitem):
+                    nid = self._lock_of(sub.context_expr, locks)
+                    if nid:
+                        acq.add(nid)
+                elif isinstance(sub, ast.Call):
+                    nid = self._lock_of(sub, locks)
+                    if nid:
+                        acq.add(nid)
+                if (
+                    isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and isinstance(sub.func.value, ast.Name)
+                    and sub.func.value.id == "self"
+                ):
+                    called.add(sub.func.attr)
+            direct[m.name] = acq
+            calls[m.name] = called
+        # Fixpoint: summary = direct ∪ summaries of self-calls.
+        summaries = {k: set(v) for k, v in direct.items()}
+        changed = True
+        while changed:
+            changed = False
+            for m, callees in calls.items():
+                for c in callees:
+                    extra = summaries.get(c, set()) - summaries[m]
+                    if extra:
+                        summaries[m].update(extra)
+                        changed = True
+        for m in methods:
+            self._walk_function(m, cls.name, locks, summaries, ctx)
+
+    def _lock_of(self, node, locks: Dict[str, str]) -> Optional[str]:
+        """Lock node for `with self._x` / `with mod_lock` context exprs and
+        bare `.acquire()` calls."""
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr == "acquire":
+                node = f.value
+            else:
+                return None
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+        ):
+            return locks.get(node.attr)
+        if isinstance(node, ast.Name):
+            return locks.get(node.id)
+        return None
+
+    def _walk_function(self, fn, owner, locks, summaries, ctx):
+        held: List[Tuple[str, int]] = []  # (node, lineno acquired)
+
+        def add_edges(dst: str, lineno: int):
+            for src, _ in held:
+                if src == dst:
+                    kind = self.nodes.get(src, {}).get("kind")
+                    if kind == "Lock":
+                        self._module_findings.append(
+                            Finding(
+                                path=ctx.relpath,
+                                line=lineno,
+                                col=0,
+                                check=self.name,
+                                message=(
+                                    f"nested re-acquisition of plain Lock "
+                                    f"`{src}` — self-deadlock (use RLock "
+                                    "or restructure)"
+                                ),
+                                line_text=ctx.line_text(lineno),
+                            )
+                        )
+                    continue
+                key = (src, dst)
+                if key not in self.edges:
+                    self.edges[key] = (ctx.relpath, lineno)
+
+        def walk(node, top=False):
+            if not top and isinstance(node, _FUNC_NODES + (ast.Lambda,)):
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                acquired = []
+                for item in node.items:
+                    nid = self._lock_of(item.context_expr, locks)
+                    if nid:
+                        add_edges(nid, item.context_expr.lineno)
+                        held.append((nid, item.context_expr.lineno))
+                        acquired.append(nid)
+                for stmt in node.body:
+                    walk(stmt)
+                for _ in acquired:
+                    held.pop()
+                return
+            if (
+                held
+                and isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "self"
+            ):
+                for dst in summaries.get(node.func.attr, ()):  # interproc edge
+                    add_edges(dst, node.lineno)
+            for child in ast.iter_child_nodes(node):
+                walk(child)
+
+        walk(fn, top=True)
+
+    # -- whole-program pass: cycle detection over the accumulated graph
+
+    def finalize(self) -> List[Finding]:
+        # Shared cycle enumeration (core.find_cycles) keeps this and the
+        # runtime sanitizer agreeing on what counts as a cycle; add_edges
+        # never inserts self-edges, so no self-loop guard is needed here.
+        adj: Dict[str, List[str]] = {}
+        for (src, dst) in self.edges:
+            adj.setdefault(src, []).append(dst)
+        return [self._cycle_finding(path) for path in find_cycles(adj)]
+
+    def _cycle_finding(self, path: List[str]) -> Finding:
+        hops = []
+        for i, src in enumerate(path):
+            dst = path[(i + 1) % len(path)]
+            where = self.edges.get((src, dst))
+            loc = f"{where[0]}:{where[1]}" if where else "?"
+            hops.append(f"{src} -> {dst} ({loc})")
+        first = self.edges.get((path[0], path[1 % len(path)]), ("?", 1))
+        return Finding(
+            path=first[0],
+            line=first[1],
+            col=0,
+            check=self.name,
+            message="lock-order cycle: " + "; ".join(hops),
+            line_text="",
+        )
+
+
+# ---------------------------------------------------------- unawaited-coroutine
+
+
+@register
+class UnawaitedCoroutineChecker(Checker):
+    name = "unawaited-coroutine"
+    description = (
+        "call to a locally-defined `async def` whose coroutine is never "
+        "awaited/scheduled (the body silently never runs)"
+    )
+
+    def check_module(self, ctx: ModuleContext) -> List[Finding]:
+        module_async: Set[str] = {
+            n.name
+            for n in ctx.tree.body
+            if isinstance(n, ast.AsyncFunctionDef)
+        }
+        out: List[Finding] = []
+
+        def visit(node, class_async: Set[str], local_async: Set[str]):
+            if isinstance(node, ast.ClassDef):
+                methods = {
+                    m.name
+                    for m in node.body
+                    if isinstance(m, ast.AsyncFunctionDef)
+                }
+                for child in ast.iter_child_nodes(node):
+                    visit(child, methods, local_async)
+                return
+            if isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+                call = node.value
+                f = call.func
+                name = None
+                if isinstance(f, ast.Name) and (
+                    f.id in module_async or f.id in local_async
+                ):
+                    name = f.id
+                elif (
+                    isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "self"
+                    and f.attr in class_async
+                ):
+                    name = f"self.{f.attr}"
+                if name:
+                    out.append(
+                        ctx.finding(
+                            call,
+                            self.name,
+                            f"coroutine `{name}(...)` is created but never "
+                            "awaited — the body never runs; `await` it or "
+                            "schedule with `asyncio.create_task`/"
+                            "`run_coroutine_threadsafe`",
+                        )
+                    )
+            if isinstance(node, _FUNC_NODES):
+                # A nested async def is only callable bare inside its
+                # definer — scope it to this function's subtree, so an
+                # unrelated same-named sync function elsewhere in the
+                # module is never flagged. Collect defs anywhere in this
+                # function's own frame (if/try/for blocks included) via
+                # _walk_body, which stops at deeper function boundaries.
+                nested = {
+                    sub.name
+                    for frame_node in _walk_body(node)
+                    for sub in ast.iter_child_nodes(frame_node)
+                    if isinstance(sub, ast.AsyncFunctionDef)
+                }
+                for child in ast.iter_child_nodes(node):
+                    visit(child, class_async, local_async | nested)
+                return
+            for child in ast.iter_child_nodes(node):
+                visit(child, class_async, local_async)
+
+        visit(ctx.tree, set(), set())
+        return out
+
+
+# ----------------------------------------------------------- dropped-object-ref
+
+
+@register
+class DroppedObjectRefChecker(Checker):
+    name = "dropped-object-ref"
+    description = (
+        "`.remote()` result discarded: task errors and completion are "
+        "unobservable, and the ref cannot be cancelled or fetched"
+    )
+
+    def check_module(self, ctx: ModuleContext) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Expr) and isinstance(node.value, ast.Call)):
+                continue
+            f = node.value.func
+            if isinstance(f, ast.Attribute) and f.attr == "remote":
+                out.append(
+                    ctx.finding(
+                        node.value,
+                        self.name,
+                        "ObjectRef from `.remote(...)` is dropped — task "
+                        "failures vanish silently; store/fetch the ref, or "
+                        "suppress with `# ray-lint: disable="
+                        "dropped-object-ref` for intentional "
+                        "fire-and-forget",
+                    )
+                )
+        return out
+
+
+# ----------------------------------------------------- resource-spec-validation
+
+# Kept in sync with ray_tpu.core.api._VALID_OPTIONS via a unit test (the
+# checker must not import the runtime: linting cannot depend on jax).
+_VALID_OPTIONS = {
+    "num_cpus", "num_gpus", "num_tpus", "resources", "num_returns",
+    "max_retries", "max_restarts", "max_concurrency", "name",
+    "scheduling_strategy", "memory", "runtime_env", "lifetime",
+    "_backpressure_num_objects",
+}
+
+_PREDEFINED_RESOURCES = {"CPU", "GPU", "TPU", "memory", "object_store_memory"}
+
+_NUMERIC_OPTIONS = {
+    "num_cpus", "num_gpus", "num_tpus", "memory", "num_returns",
+    "max_retries", "max_restarts", "max_concurrency",
+}
+
+# Calls whose `resources=` kwarg *registers* capacity (vs requesting it).
+_REGISTRATION_CALLS = {"init", "add_node", "revive_node", "start_node"}
+
+
+def _const_num(node) -> Optional[float]:
+    """Numeric value of a literal, including the `-1` UnaryOp spelling."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, (int, float)):
+        return node.value
+    if (
+        isinstance(node, ast.UnaryOp)
+        and isinstance(node.op, ast.USub)
+        and isinstance(node.operand, ast.Constant)
+        and isinstance(node.operand.value, (int, float))
+    ):
+        return -node.operand.value
+    return None
+
+
+@register
+class ResourceSpecChecker(Checker):
+    name = "resource-spec-validation"
+    description = (
+        "task/actor resource spec the scheduler layer can never satisfy: "
+        "unknown option, negative amount, predefined name in custom "
+        "`resources`, or custom resource no node registers"
+    )
+
+    def __init__(self):
+        # custom resource name -> first request site
+        self._requested: Dict[str, Tuple[str, int, str]] = {}
+        self._registered: Set[str] = set(_PREDEFINED_RESOURCES)
+
+    def check_module(self, ctx: ModuleContext) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, _FUNC_NODES + (ast.ClassDef,)):
+                for dec in _remote_decorator_calls(node):
+                    self._check_options(
+                        dec, ctx, out, strict_unknown=True
+                    )
+            if isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Attribute) and f.attr == "options":
+                    known = any(
+                        kw.arg in _VALID_OPTIONS for kw in node.keywords
+                    )
+                    if known:
+                        self._check_options(
+                            node, ctx, out, strict_unknown=False
+                        )
+                # capacity registration sites feed the known-names set
+                name = f.attr if isinstance(f, ast.Attribute) else (
+                    f.id if isinstance(f, ast.Name) else None
+                )
+                if name in _REGISTRATION_CALLS:
+                    for kw in node.keywords:
+                        if kw.arg == "resources" and isinstance(
+                            kw.value, ast.Dict
+                        ):
+                            for k in kw.value.keys:
+                                if isinstance(k, ast.Constant) and isinstance(
+                                    k.value, str
+                                ):
+                                    self._registered.add(k.value)
+        return out
+
+    def _check_options(self, call: ast.Call, ctx, out, strict_unknown: bool):
+        for kw in call.keywords:
+            if kw.arg is None:  # **kwargs — can't validate statically
+                continue
+            if strict_unknown and kw.arg not in _VALID_OPTIONS:
+                out.append(
+                    ctx.finding(
+                        kw.value,
+                        self.name,
+                        f"unknown remote option `{kw.arg}` (valid: "
+                        f"{', '.join(sorted(_VALID_OPTIONS))})",
+                    )
+                )
+                continue
+            v = kw.value
+            if kw.arg in _NUMERIC_OPTIONS:
+                num = _const_num(v)
+                # -1 is the conventional "infinite" sentinel for retry
+                # budgets (reference: ray.remote(max_retries=-1)).
+                if (
+                    kw.arg in ("max_retries", "max_restarts")
+                    and num == -1
+                ):
+                    num = None
+                if num is not None and num < 0:
+                    out.append(
+                        ctx.finding(
+                            v,
+                            self.name,
+                            f"negative resource amount `{kw.arg}={num}` "
+                            "can never be satisfied",
+                        )
+                    )
+                if kw.arg == "max_concurrency" and num == 0:
+                    out.append(
+                        ctx.finding(
+                            v, self.name, "`max_concurrency=0` — the actor "
+                            "could never run a task",
+                        )
+                    )
+            if kw.arg == "resources" and isinstance(v, ast.Dict):
+                for k, val in zip(v.keys, v.values):
+                    if not isinstance(k, ast.Constant):
+                        continue
+                    if not isinstance(k.value, str):
+                        out.append(
+                            ctx.finding(
+                                k,
+                                self.name,
+                                f"resource name {k.value!r} must be a "
+                                "string",
+                            )
+                        )
+                        continue
+                    if k.value in _PREDEFINED_RESOURCES:
+                        out.append(
+                            ctx.finding(
+                                k,
+                                self.name,
+                                f"predefined resource `{k.value}` in "
+                                "custom `resources=`; use the dedicated "
+                                "option (num_cpus/num_gpus/num_tpus/"
+                                "memory)",
+                            )
+                        )
+                        continue
+                    amount = _const_num(val)
+                    if amount is not None and amount < 0:
+                        out.append(
+                            ctx.finding(
+                                val,
+                                self.name,
+                                f"negative amount for resource "
+                                f"`{k.value}`",
+                            )
+                        )
+                    if k.value not in self._requested:
+                        self._requested[k.value] = (
+                            ctx.relpath,
+                            k.lineno,
+                            ctx.line_text(k.lineno),
+                        )
+
+    def finalize(self) -> List[Finding]:
+        out: List[Finding] = []
+        for name, (path, line, text) in sorted(self._requested.items()):
+            if name not in self._registered:
+                out.append(
+                    Finding(
+                        path=path,
+                        line=line,
+                        col=0,
+                        check=self.name,
+                        message=(
+                            f"custom resource `{name}` is requested but no "
+                            "scanned registration site (init/add_node) "
+                            "ever provides it — tasks would pend forever"
+                        ),
+                        line_text=text,
+                    )
+                )
+        return out
+
+
+def static_lock_graph(paths, root=None):
+    """The lock-order checker's accumulated graph for the given paths:
+    ({node: {kind, where}}, {(src, dst): (path, line)}). Used by tests to
+    cross-check the static graph against sanitizer-observed orderings.
+    Raises on unparseable input — a silently empty graph would make that
+    cross-check pass vacuously."""
+    from ray_tpu.analysis.core import iter_modules
+
+    chk = LockOrderCycleChecker()
+    errors: List[str] = []
+    for ctx in iter_modules(paths, root=root, errors=errors):
+        chk.check_module(ctx)
+    if errors:
+        raise ValueError(
+            "static_lock_graph: unparseable file(s): " + "; ".join(errors)
+        )
+    return chk.nodes, chk.edges

@@ -1,0 +1,203 @@
+"""Runtime lock-order sanitizer: a ThreadSanitizer-style happens-before
+lock-order recorder for the Python layer.
+
+``LockOrderSanitizer.install()`` monkeypatches ``threading.Lock`` /
+``threading.RLock`` factories so every lock allocated afterwards is wrapped
+in an instrumented shim. Each acquisition records, per OS thread, the
+currently-held lock set and adds ``held -> acquiring`` edges to a global
+order graph keyed by the lock's *allocation site* (file:line), the runtime
+analogue of the static checker's ``Class.attr`` nodes. ``cycles()`` then
+reports any cyclic ordering actually observed — the dynamic cross-check
+for the static ``lock-order-cycle`` checker (tests opt in via the
+``lock_sanitizer`` conftest fixture).
+
+The shim forwards everything else (``locked``, ``_is_owned``, …) to the
+real lock, so ``threading.Condition`` built on an instrumented lock keeps
+working: Condition binds ``acquire``/``release`` from the shim, and its
+default wait/notify path calls straight through them.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+from ray_tpu.analysis.core import find_cycles
+
+_THIS_FILE = __file__
+
+# Module-level recording state. uninstall() cannot unwrap locks that were
+# already handed out, so a shim may outlive its creating sanitizer; edges
+# must therefore route through whichever sanitizer is *currently* active
+# (else an inversion between an old-wrapped and a new-wrapped lock lands
+# in neither graph), and the per-thread held stack must be shared so
+# cross-install nestings are seen at all.
+_active: Optional["LockOrderSanitizer"] = None
+_held_tls = threading.local()
+
+
+def _held_stack() -> List[Tuple[str, int]]:
+    st = getattr(_held_tls, "stack", None)
+    if st is None:
+        st = _held_tls.stack = []
+    return st
+
+
+def _caller_site(depth: int = 2) -> Tuple[str, int]:
+    """Allocation site of the lock: first frame outside this module and
+    outside threading.py (Condition() allocates an RLock internally)."""
+    f = sys._getframe(depth)
+    while f is not None:
+        fn = f.f_code.co_filename
+        if fn != _THIS_FILE and not fn.endswith("threading.py"):
+            return (fn, f.f_lineno)
+        f = f.f_back
+    return ("<unknown>", 0)
+
+
+class _InstrumentedLock:
+    """Wraps a real Lock/RLock; records acquisition order per thread
+    (through the module's currently-active sanitizer, not necessarily
+    the one that wrapped it)."""
+
+    def __init__(self, inner, site: Tuple[str, int]):
+        self._inner = inner
+        self._site = site
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            held = _held_stack()
+            san = _active
+            if san is not None:
+                san._record(held, self._site)
+            held.append(self._site)
+        return ok
+
+    def release(self):
+        held = _held_stack()
+        # Locks are usually released LIFO; tolerate out-of-order release.
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == self._site:
+                del held[i]
+                break
+        self._inner.release()
+
+    __enter__ = acquire
+
+    def __exit__(self, *exc):
+        self.release()
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __getattr__(self, name):
+        # RLock's _release_save/_acquire_restore/_is_owned (used by
+        # Condition) and anything else fall through to the real lock.
+        return getattr(self._inner, name)
+
+
+class LockOrderSanitizer:
+    def __init__(self):
+        self._graph_mu = threading.Lock()  # guards edges/sites; never wrapped
+        # (src_site, dst_site) -> observation count
+        self.edges: Dict[Tuple[Tuple[str, int], Tuple[str, int]], int] = {}
+        self.sites: Set[Tuple[str, int]] = set()
+        self._installed = False
+        self._orig_lock = None
+        self._orig_rlock = None
+
+    # ------------------------------------------------------------- recording
+
+    def _record(self, held: List[Tuple[str, int]], site: Tuple[str, int]):
+        with self._graph_mu:
+            self.sites.add(site)
+            for src in held:
+                if src != site:
+                    key = (src, site)
+                    self.edges[key] = self.edges.get(key, 0) + 1
+
+    # ----------------------------------------------------------- install/undo
+
+    def install(self):
+        global _active
+        if self._installed:
+            return self
+        self._orig_lock = threading.Lock
+        self._orig_rlock = threading.RLock
+        san = self
+
+        def make_lock():
+            lk = _InstrumentedLock(san._orig_lock(), _caller_site())
+            with san._graph_mu:
+                san.sites.add(lk._site)
+            return lk
+
+        def make_rlock():
+            lk = _InstrumentedLock(san._orig_rlock(), _caller_site())
+            with san._graph_mu:
+                san.sites.add(lk._site)
+            return lk
+
+        threading.Lock = make_lock
+        threading.RLock = make_rlock
+        self._installed = True
+        _active = self
+        return self
+
+    def uninstall(self):
+        global _active
+        if not self._installed:
+            return
+        threading.Lock = self._orig_lock
+        threading.RLock = self._orig_rlock
+        self._installed = False
+        if _active is self:
+            _active = None
+
+    def __enter__(self):
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()
+
+    # -------------------------------------------------------------- reporting
+
+    def observed_edges(self) -> List[Tuple[Tuple[str, int], Tuple[str, int]]]:
+        with self._graph_mu:
+            return sorted(self.edges)
+
+    def cycles(self) -> List[List[Tuple[str, int]]]:
+        """Cyclic lock orderings observed at runtime. Any cycle here is a
+        potential deadlock: two threads interleaving those paths wedge.
+        Uses the same cycle enumeration (core.find_cycles) as the static
+        ``lock-order-cycle`` checker, so the two halves cannot diverge on
+        what counts as a cycle (``_on_acquire`` never records self-edges)."""
+        with self._graph_mu:
+            adj: Dict[Tuple[str, int], List] = {}
+            for (src, dst) in self.edges:
+                adj.setdefault(src, []).append(dst)
+        return find_cycles(adj)
+
+    def assert_no_cycles(self):
+        cyc = self.cycles()
+        if cyc:
+            lines = [
+                " -> ".join(f"{f}:{ln}" for (f, ln) in c + [c[0]])
+                for c in cyc
+            ]
+            raise AssertionError(
+                "lock-order cycles observed at runtime:\n" + "\n".join(lines)
+            )
+
+    def site_for_line(self, filename_suffix: str, lineno: Optional[int] = None):
+        """Find a recorded allocation site by file suffix (+ line), for
+        mapping observed sites back to static lock nodes in tests."""
+        with self._graph_mu:
+            for (fn, ln) in self.sites:
+                if fn.endswith(filename_suffix) and (
+                    lineno is None or ln == lineno
+                ):
+                    return (fn, ln)
+        return None

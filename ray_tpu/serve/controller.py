@@ -145,7 +145,9 @@ class ServeController:
             rid = st.replica_ids[idx]
             st.stats.pop(rid, None)
         try:
-            replica.stop_metrics.remote()  # best-effort thread stop
+            # best-effort, fire-and-forget thread stop on a replica that is
+            # about to be killed — there is no result worth fetching
+            replica.stop_metrics.remote()  # ray-lint: disable=dropped-object-ref
         except Exception:
             pass
         self._kill(replica)

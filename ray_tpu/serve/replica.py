@@ -29,7 +29,12 @@ class ServeReplica:
                  identity: Optional[tuple] = None,
                  metrics_period_s: float = 0.2,
                  max_ongoing_requests: int = 32):
-        self._lock = threading.Lock()
+        # No lock around these counters: handle_request and stats() both
+        # execute on the actor's event-loop thread (async-actor contract),
+        # so mutation is single-threaded; the metrics thread only does a
+        # GIL-atomic int read. A threading.Lock here would block the loop
+        # whenever the metrics thread held it (found by ray-lint
+        # blocking-in-async).
         self._ongoing = 0
         self._total = 0
         # sync handlers run here, NOT on the loop's default executor: the
@@ -81,16 +86,16 @@ class ServeReplica:
             try:
                 if ctrl is None:
                     ctrl = _rt.get_actor("serve:controller")
-                with self._lock:
-                    ongoing = self._ongoing
-                ctrl.record_stats.remote(list(self._identity), ongoing)
+                ongoing = self._ongoing
+                # fire-and-forget metrics push; a lost sample is harmless
+                # and the next tick re-reports
+                ctrl.record_stats.remote(list(self._identity), ongoing)  # ray-lint: disable=dropped-object-ref
             except Exception:
                 ctrl = None  # controller gone/respawned; re-resolve
 
     async def handle_request(self, method_name: str, args, kwargs):
-        with self._lock:
-            self._ongoing += 1
-            self._total += 1
+        self._ongoing += 1
+        self._total += 1
         try:
             if self._is_function:
                 target = self._callable
@@ -106,8 +111,7 @@ class ServeReplica:
                 self._sync_pool, lambda: target(*args, **kwargs)
             )
         finally:
-            with self._lock:
-                self._ongoing -= 1
+            self._ongoing -= 1
 
     def reconfigure(self, user_config: Dict):
         if hasattr(self._callable, "reconfigure"):
@@ -115,8 +119,8 @@ class ServeReplica:
         return True
 
     def stats(self) -> Dict[str, Any]:
-        with self._lock:
-            return {"ongoing": self._ongoing, "total": self._total}
+        # runs on the loop thread, so both counters are read consistently
+        return {"ongoing": self._ongoing, "total": self._total}
 
     def health_check(self) -> bool:
         if hasattr(self._callable, "check_health"):

@@ -37,3 +37,23 @@ def local_ray():
     ray_tpu.init(num_cpus=4, ignore_reinit_error=False)
     yield ray_tpu
     ray_tpu.shutdown()
+
+
+@pytest.fixture
+def lock_sanitizer():
+    """Opt-in runtime lock-order recorder (ray_tpu.analysis.sanitizer).
+
+    While installed, every ``threading.Lock``/``RLock`` allocated is
+    wrapped in an instrumented shim that records per-thread acquisition
+    orderings keyed by allocation site, so tests can cross-check the
+    static ``lock-order-cycle`` graph against what actually happens
+    (``san.assert_no_cycles()``) — the Python analogue of running the
+    suite under ThreadSanitizer.
+    """
+    from ray_tpu.analysis.sanitizer import LockOrderSanitizer
+
+    san = LockOrderSanitizer().install()
+    try:
+        yield san
+    finally:
+        san.uninstall()

@@ -370,3 +370,30 @@ def test_async_deployment_in_replica_concurrency(ray8):
     time.sleep(0.3)
     assert h.remote("open").result(timeout=10) == "opened"
     assert [w.result(timeout=10) for w in waiters] == ["released"] * 5
+
+
+def test_replica_request_counters_without_lock(ray8):
+    """Regression for the ray-lint blocking-in-async fix: the replica's
+    ongoing/total counters are loop-confined (no threading.Lock shared
+    with the metrics thread, which used to be able to stall the event
+    loop). Counters must stay exact across interleaved async requests."""
+    import asyncio
+
+    @serve.deployment(num_replicas=1, max_ongoing_requests=16)
+    class Counted:
+        async def __call__(self, x):
+            await asyncio.sleep(0.01)
+            return x
+
+    h = serve.run(Counted.bind(), route_prefix=None)
+    n = 12
+    assert [r.result(timeout=10) for r in [h.remote(i) for i in range(n)]] \
+        == list(range(n))
+
+    from ray_tpu.serve.api import _get_controller
+
+    ctrl = _get_controller()
+    info = ray_tpu.get(ctrl.get_replicas.remote("default", "Counted"))
+    (replica,) = info["replicas"]
+    stats = ray_tpu.get(replica.stats.remote())
+    assert stats == {"ongoing": 0, "total": n}
