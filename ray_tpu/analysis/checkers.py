@@ -1058,6 +1058,58 @@ class ResourceSpecChecker(Checker):
         return out
 
 
+# --------------------------------------------------------- unbounded-rpc-call
+
+# Directory segments that count as control plane: a blocked thread there
+# wedges a daemon loop, the GCS, or a driver's submission path.
+_CONTROL_PLANE_SEGMENTS = {"cluster"}
+
+
+@register
+class UnboundedRpcCallChecker(Checker):
+    name = "unbounded-rpc-call"
+    description = (
+        "control-plane `.call(\"method\", ...)` without an explicit "
+        "`timeout=`: the call rides the client-default deadline, which a "
+        "daemon/GCS/driver loop never chose — every blocking rpc in "
+        "cluster/ must bound its wait explicitly"
+    )
+
+    def check_module(self, ctx: ModuleContext) -> List[Finding]:
+        parts = ctx.relpath.replace("\\", "/").split("/")
+        if not (set(parts[:-1]) & _CONTROL_PLANE_SEGMENTS):
+            return []
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not (isinstance(f, ast.Attribute) and f.attr == "call"):
+                continue
+            # the rpc idiom: first positional arg is the method-name string
+            # (skips unrelated `.call(x)` where x is a variable)
+            if not (
+                node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                continue
+            if any(kw.arg == "timeout" for kw in node.keywords):
+                continue
+            out.append(
+                ctx.finding(
+                    node,
+                    self.name,
+                    f"rpc `{node.args[0].value}` has no explicit timeout — "
+                    "a hung peer wedges this thread for the client-default "
+                    "window; pass `timeout=` (config rpc_call_timeout_s or "
+                    "tighter), or suppress with `# ray-lint: "
+                    "disable=unbounded-rpc-call`",
+                )
+            )
+        return out
+
+
 def static_lock_graph(paths, root=None):
     """The lock-order checker's accumulated graph for the given paths:
     ({node: {kind, where}}, {(src, dst): (path, line)}). Used by tests to

@@ -33,7 +33,7 @@ from ray_tpu.core.exceptions import (
 from ray_tpu.core.memory_store import MemoryStore
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.task_spec import TaskSpec, new_id
-from ray_tpu.cluster.rpc import ConnectionLost, RpcClient
+from ray_tpu.cluster.rpc import ConnectionLost, RetryingRpcClient, RpcClient
 
 
 class _ActorQueue:
@@ -101,8 +101,9 @@ def _parse_address(address) -> Tuple[str, int]:
 class ClusterClient:
     def __init__(self, address, config: Optional[Config] = None):
         self.config = config or Config()
+        # one named knob for every control-plane call deadline in here
+        self._rpc_timeout = self.config.rpc_call_timeout_s
         host, port = _parse_address(address)
-        self.gcs = RpcClient(host, port)
         self.worker_id = new_id("driver")
         self.node_id = "driver"
         self.store = MemoryStore()  # resolved values (inline or fetched)
@@ -167,6 +168,21 @@ class ClusterClient:
         self._gc_queue: deque = deque()
         self._gcs_host, self._gcs_port = host, port
         self._closed = False
+        self._nodes: Dict[str, dict] = {}
+        # workers embed a ClusterClient too; they register flagged so the
+        # GCS excludes them from worker-log fanout (a worker printing
+        # received logs would echo them back through its own log pump)
+        self._is_worker_client = "RAY_TPU_WORKER_ID" in __import__("os").environ
+        # Auto-reconnecting GCS session (reference: GCS FT — core workers
+        # reconnect + resubscribe): _gcs_session re-registers and resubmits
+        # unfinished tasks on every reconnect, so a GCS restart at any
+        # point is survivable rather than fatal.
+        self.gcs = RetryingRpcClient(
+            host, port, name=self.worker_id, peer="gcs",
+            on_session=self._gcs_session, auto_connect=False,
+            config=self.config,
+        )
+        self.gcs.on_reconnect_timeout = self._on_gcs_reconnect_timeout
         self.gcs.subscribe("task_result", self._on_task_result)
         self.gcs.subscribe("stream_item", self._on_stream_item)
         self.gcs.subscribe("actor_update", self._on_actor_update)
@@ -174,16 +190,7 @@ class ClusterClient:
         self.gcs.subscribe("borrow_added", self._on_borrow_added)
         self.gcs.subscribe("borrow_released", self._on_borrow_released)
         self.gcs.subscribe("worker_logs", self._on_worker_logs)
-        self.gcs.on_close = self._on_gcs_lost
-        # workers embed a ClusterClient too; they register flagged so the
-        # GCS excludes them from worker-log fanout (a worker printing
-        # received logs would echo them back through its own log pump)
-        self._is_worker_client = "RAY_TPU_WORKER_ID" in __import__("os").environ
-        reply = self.gcs.call("register_driver", {
-            "driver_id": self.worker_id, "worker": self._is_worker_client,
-            "logs": bool(self.config.log_to_driver),
-        })
-        self._nodes: Dict[str, dict] = reply["nodes"]
+        self.gcs.connect()
         self._put_rr = 0
         self._gc_thread = threading.Thread(
             target=self._gc_loop, daemon=True, name="driver-gc"
@@ -369,67 +376,50 @@ class ClusterClient:
                 continue
             self.store.delete([ObjectRef(oid) for oid in drop])
             try:
-                self.gcs.call("free_objects", {"object_ids": drop})
+                self.gcs.call("free_objects", {"object_ids": drop}, timeout=self._rpc_timeout)
             except Exception:  # noqa: BLE001
                 pass
 
     # -------------------------------------------------- GCS reconnection
 
-    def _on_gcs_lost(self):
-        if self._closed:
-            return
-        threading.Thread(
-            target=self._gcs_reconnect_loop, daemon=True,
-            name="driver-gcs-reconnect",
-        ).start()
-
-    def _gcs_reconnect_loop(self):
-        """Reconnect to a restarted GCS and resubmit unfinished tasks
-        (at-least-once across a control-plane restart; reference: GCS FT
-        with workers reconnecting/resubscribing)."""
-        import time as _time
-
-        deadline = _time.time() + self.config.gcs_reconnect_timeout_s
-        while not self._closed and _time.time() < deadline:
-            _time.sleep(0.2)
+    def _gcs_session(self, gcs: RpcClient, first: bool):
+        """(Re)establish the driver's GCS session on a fresh connection
+        (runs inside RetryingRpcClient before the connection is published;
+        subscriptions were already replayed). On reconnects, resubmit every
+        unfinished normal task — at-least-once across a control-plane
+        restart; the GCS dedupes duplicates."""
+        timeout = self.config.rpc_call_timeout_s
+        reply = gcs.call("register_driver", {
+            "driver_id": self.worker_id,
+            "worker": self._is_worker_client,
+            "logs": bool(self.config.log_to_driver),
+        }, timeout=timeout)
+        with self._lock:
+            self._nodes = reply["nodes"]
+            if first:
+                return
+            unfinished = []
+            for tid, meta in self._task_meta.items():
+                if meta.get("actor_creation") or meta.get("actor_id"):
+                    continue
+                first_out = ObjectRef.for_task_output(
+                    tid, 0, owner=self.worker_id
+                )
+                if not self.store.contains(first_out):
+                    unfinished.append(dict(meta))
+        for meta in unfinished:
             try:
-                gcs = RpcClient(self._gcs_host, self._gcs_port)
-                gcs.subscribe("task_result", self._on_task_result)
-                gcs.subscribe("stream_item", self._on_stream_item)
-                gcs.subscribe("actor_update", self._on_actor_update)
-                gcs.subscribe("nodes", self._on_nodes)
-                gcs.subscribe("borrow_added", self._on_borrow_added)
-                gcs.subscribe("borrow_released", self._on_borrow_released)
-                gcs.subscribe("worker_logs", self._on_worker_logs)
-                gcs.on_close = self._on_gcs_lost
-                reply = gcs.call("register_driver", {
-                    "driver_id": self.worker_id,
-                    "worker": self._is_worker_client,
-                    "logs": bool(self.config.log_to_driver),
-                })
-            except OSError:
-                continue
-            with self._lock:
-                self._nodes = reply["nodes"]
-                unfinished = []
-                for tid, meta in self._task_meta.items():
-                    if meta.get("actor_creation") or meta.get("actor_id"):
-                        continue
-                    first_out = ObjectRef.for_task_output(
-                        tid, 0, owner=self.worker_id
-                    )
-                    if not self.store.contains(first_out):
-                        unfinished.append(dict(meta))
-            self.gcs = gcs
-            for meta in unfinished:
-                try:
-                    self._refresh_inflight_deps(meta)
-                    gcs.call("submit_task", meta)
-                except Exception:
-                    pass
-            return
-        # the GCS never came back: without this, every unfinished task's
-        # refs would hang forever (the submit callbacks deferred to us)
+                self._refresh_inflight_deps(meta)
+                gcs.call("submit_task", meta, timeout=timeout)
+            except Exception:
+                pass
+
+    def _on_gcs_reconnect_timeout(self):
+        """The GCS stayed unreachable past the reconnect window: fail
+        unfinished tasks' refs so gets raise instead of hanging forever
+        (the submit callbacks deferred their failures to the reconnect
+        plane). Reconnection itself keeps retrying — a GCS back later
+        still restores the session for NEW work."""
         with self._lock:
             stranded = [
                 dict(m) for tid, m in self._task_meta.items()
@@ -477,7 +467,7 @@ class ClusterClient:
                 "class_name": getattr(spec.func, "__name__", "Actor"),
                 "max_restarts": spec.max_restarts,
                 "name": spec.name,
-            })
+            }, timeout=self._rpc_timeout)
         with self._lock:
             self._task_meta[spec.task_id] = meta
         self._track_submission(spec.task_id, meta, refs)
@@ -817,7 +807,7 @@ class ClusterClient:
                 info = self._actor_cache.get(actor_id)
             if info and info.get("state") == "ALIVE" and info.get("node_id"):
                 return info
-            info = self.gcs.call("get_actor", {"actor_id": actor_id})
+            info = self.gcs.call("get_actor", {"actor_id": actor_id}, timeout=self._rpc_timeout)
             if info:
                 with self._lock:
                     self._actor_cache[actor_id] = info
@@ -851,7 +841,7 @@ class ClusterClient:
         if n >= 10:
             return False
         try:
-            info = self.gcs.call("get_actor", {"actor_id": actor_id})
+            info = self.gcs.call("get_actor", {"actor_id": actor_id}, timeout=self._rpc_timeout)
         except Exception:  # noqa: BLE001
             return False
         if not info or info.get("state") == "DEAD":
@@ -891,7 +881,7 @@ class ClusterClient:
         """Was this stream item actually produced? (GCS directory check —
         authoritative even when the push announcement was lost.)"""
         try:
-            loc = self.gcs.call("locate_object", {"object_id": ref.id})
+            loc = self.gcs.call("locate_object", {"object_id": ref.id}, timeout=self._rpc_timeout)
         except Exception:  # noqa: BLE001 - GCS mid-restart
             return False
         return bool(loc.get("nodes"))
@@ -992,7 +982,7 @@ class ClusterClient:
             for d in lost_deps:
                 oid = d["id"]
                 try:
-                    loc = self.gcs.call("locate_object", {"object_id": oid})
+                    loc = self.gcs.call("locate_object", {"object_id": oid}, timeout=self._rpc_timeout)
                 except Exception:  # noqa: BLE001
                     loc = {}
                 if loc.get("nodes"):
@@ -1013,7 +1003,9 @@ class ClusterClient:
                             node["node_id"], node["addr"], node["port"]
                         )
                         daemon.call(
-                            "put_object", {"object_id": oid, "payload": payload}
+                            "put_object",
+                            {"object_id": oid, "payload": payload},
+                            timeout=self._rpc_timeout,
                         )
                         continue
                 # lineage: resubmit the producing task (deduped)
@@ -1027,7 +1019,8 @@ class ClusterClient:
                         self._reconstructing.add(ptid)
                     try:
                         self._refresh_inflight_deps(pmeta)
-                        self.gcs.call("submit_task", pmeta)
+                        self.gcs.call("submit_task", pmeta,
+                                      timeout=self._rpc_timeout)
                     except Exception:
                         # leave the door open for a later repair attempt
                         with self._lock:
@@ -1045,7 +1038,7 @@ class ClusterClient:
                 meta["_dep_refunds"] = meta.get("_dep_refunds", 0) + 1
                 meta["retries_left"] = meta.get("retries_left", 0) + 1
             self._refresh_inflight_deps(meta)
-            self.gcs.call("submit_task", meta)
+            self.gcs.call("submit_task", meta, timeout=self._rpc_timeout)
         except Exception as e:  # noqa: BLE001
             self._fail_task_refs(meta["task_id"], meta, f"lineage repair: {e!r}")
 
@@ -1100,6 +1093,7 @@ class ClusterClient:
                         daemon.call(
                             "put_object",
                             {"object_id": r.id, "payload": payload},
+                            timeout=self._rpc_timeout,
                         )
                     except Exception:  # noqa: BLE001
                         pending.append(r)
@@ -1192,9 +1186,12 @@ class ClusterClient:
         if seg is not None:
             stored = seg.put_with_make_room(ref.id, payload, daemon)
             if stored:
-                daemon.call("note_object", {"object_id": ref.id})
+                daemon.call("note_object", {"object_id": ref.id},
+                            timeout=self._rpc_timeout)
         if not stored:
-            daemon.call("put_object", {"object_id": ref.id, "payload": payload})
+            daemon.call("put_object",
+                        {"object_id": ref.id, "payload": payload},
+                        timeout=self._rpc_timeout)
         self.store.put(ref, value)  # local cache
         self._register_ref(ref)
         return ref
@@ -1220,7 +1217,7 @@ class ClusterClient:
             c = self._daemon_conns.get(node_id)
             if c is not None and not c._closed:
                 return c
-        c = RpcClient(addr, port)
+        c = RpcClient(addr, port, name=self.worker_id, peer=node_id)
         with self._lock:
             self._daemon_conns[node_id] = c
         return c
@@ -1232,7 +1229,7 @@ class ClusterClient:
         deadline = time.time() + timeout
         attempted_reconstruct = False
         while time.time() < deadline:
-            loc = self.gcs.call("locate_object", {"object_id": ref.id})
+            loc = self.gcs.call("locate_object", {"object_id": ref.id}, timeout=self._rpc_timeout)
             for entry in loc.get("nodes", []):
                 seg = self._local_shm(entry["node_id"])
                 if seg is not None:
@@ -1265,7 +1262,8 @@ class ClusterClient:
                 if meta is not None:
                     # result will arrive via the normal task_result push
                     self.store.delete([ref])
-                    self.gcs.call("submit_task", meta)
+                    self.gcs.call("submit_task", meta,
+                                  timeout=self._rpc_timeout)
                     return self._get_one(ref, deadline)
             time.sleep(0.05)
         raise ObjectLostError(f"object {ref.id[:8]} could not be retrieved")
@@ -1291,7 +1289,7 @@ class ClusterClient:
                 raise GetTimeoutError(f"get timed out on {ref.id[:8]}")
             if not owned:
                 # produced by another worker/driver: poll the directory
-                loc = self.gcs.call("locate_object", {"object_id": ref.id})
+                loc = self.gcs.call("locate_object", {"object_id": ref.id}, timeout=self._rpc_timeout)
                 if loc.get("nodes"):
                     remaining = 30.0 if deadline is None else max(0.1, deadline - time.time())
                     return self._fetch(ref, remaining, allow_reconstruct=False)
@@ -1329,7 +1327,7 @@ class ClusterClient:
                 for r in foreign:
                     if r.id in foreign_ready:
                         continue
-                    loc = self.gcs.call("locate_object", {"object_id": r.id})
+                    loc = self.gcs.call("locate_object", {"object_id": r.id}, timeout=self._rpc_timeout)
                     if loc.get("nodes"):
                         foreign_ready.add(r.id)
                 continue
@@ -1343,72 +1341,72 @@ class ClusterClient:
 
     def free(self, refs: List[ObjectRef]):
         self.store.delete(refs)
-        self.gcs.call("free_objects", {"object_ids": [r.id for r in refs]})
+        self.gcs.call("free_objects", {"object_ids": [r.id for r in refs]}, timeout=self._rpc_timeout)
 
     # ---------------------------------------------------------------- misc
 
     def create_placement_group(self, pg_id, bundles, strategy, name=""):
         return self.gcs.call("create_placement_group", {
             "pg_id": pg_id, "bundles": bundles, "strategy": strategy, "name": name,
-        })
+        }, timeout=self._rpc_timeout)
 
     def remove_placement_group(self, pg_id):
-        self.gcs.call("remove_placement_group", {"pg_id": pg_id})
+        self.gcs.call("remove_placement_group", {"pg_id": pg_id}, timeout=self._rpc_timeout)
 
     def get_placement_group(self, pg_id):
-        return self.gcs.call("get_placement_group", {"pg_id": pg_id})
+        return self.gcs.call("get_placement_group", {"pg_id": pg_id}, timeout=self._rpc_timeout)
 
     def kill_actor(self, actor_id: str, no_restart: bool = True):
-        self.gcs.call("kill_actor", {"actor_id": actor_id})
+        self.gcs.call("kill_actor", {"actor_id": actor_id}, timeout=self._rpc_timeout)
         with self._lock:
             info = self._actor_cache.get(actor_id)
             if info is not None:
                 info["state"] = "DEAD"
 
     def cluster_resources(self) -> Dict[str, float]:
-        return self.gcs.call("cluster_resources")
+        return self.gcs.call("cluster_resources", timeout=self._rpc_timeout)
 
     def available_resources(self) -> Dict[str, float]:
-        return self.gcs.call("available_resources")
+        return self.gcs.call("available_resources", timeout=self._rpc_timeout)
 
     # ------------------------------------------------------------ state API
 
     def list_tasks(self, limit: int = 1000) -> List[dict]:
-        return self.gcs.call("list_tasks", {"limit": limit})
+        return self.gcs.call("list_tasks", {"limit": limit}, timeout=self._rpc_timeout)
 
     def summarize_tasks(self) -> dict:
         """Full-history per-name/status counts from the GCS's incremental
         aggregates — exact at any task count, unlike listing events."""
-        return self.gcs.call("summarize_tasks", {})
+        return self.gcs.call("summarize_tasks", {}, timeout=self._rpc_timeout)
 
     def list_actors(self) -> List[dict]:
-        return self.gcs.call("list_actors", {})
+        return self.gcs.call("list_actors", {}, timeout=self._rpc_timeout)
 
     def list_placement_groups(self) -> List[dict]:
-        return self.gcs.call("list_placement_groups", {})
+        return self.gcs.call("list_placement_groups", {}, timeout=self._rpc_timeout)
 
     def list_objects(self, limit: int = 1000) -> List[dict]:
         return self.store.list_entries(limit)
 
     def summary(self) -> dict:
-        return self.gcs.call("summary", {})
+        return self.gcs.call("summary", {}, timeout=self._rpc_timeout)
 
     # ------------------------------------------------------------- kv store
 
     def kv_put(self, key: str, value):
-        self.gcs.call("kv_put", {"key": key, "value": value})
+        self.gcs.call("kv_put", {"key": key, "value": value}, timeout=self._rpc_timeout)
 
     def kv_get(self, key: str):
-        return self.gcs.call("kv_get", {"key": key})
+        return self.gcs.call("kv_get", {"key": key}, timeout=self._rpc_timeout)
 
     def kv_del(self, key: str):
-        self.gcs.call("kv_del", {"key": key})
+        self.gcs.call("kv_del", {"key": key}, timeout=self._rpc_timeout)
 
     def kv_keys(self, prefix: str = ""):
-        return self.gcs.call("kv_keys", {"prefix": prefix})
+        return self.gcs.call("kv_keys", {"prefix": prefix}, timeout=self._rpc_timeout)
 
     def nodes(self) -> List[dict]:
-        raw = self.gcs.call("get_nodes")
+        raw = self.gcs.call("get_nodes", timeout=self._rpc_timeout)
         return [
             {"NodeID": nid, "Alive": n["alive"], "Resources": n["resources"],
              "Labels": n.get("labels", {}), "Stats": n.get("stats") or {}}
@@ -1416,7 +1414,7 @@ class ClusterClient:
         ]
 
     def timeline(self) -> List[dict]:
-        return self.gcs.call("list_tasks")
+        return self.gcs.call("list_tasks", timeout=self._rpc_timeout)
 
     def current_task_id(self):
         return None

@@ -27,6 +27,17 @@ class Cluster:
             host=host, config=self.config, persistence_path=persistence_path
         )
         self.daemons = []
+        # chaos kill hooks: registered unconditionally in the PROCESS-level
+        # registry (not on a schedule instance), so a fault plane installed
+        # before OR after cluster construction finds its targets
+        # (reference: the node-killer utilities behind test_chaos.py)
+        from ray_tpu.chaos import schedule as _chaos_sched
+
+        self._chaos_sched = _chaos_sched
+        # (name, fn) pairs: shutdown removes exactly what THIS cluster
+        # registered (a later cluster reusing a name keeps its entry)
+        self._kill_targets: list = [("gcs-restart", self.restart_gcs)]
+        _chaos_sched.register_kill("gcs-restart", self.restart_gcs)
 
     def restart_gcs(self):
         """Kill and restart the GCS at the SAME port from its persisted
@@ -64,6 +75,10 @@ class Cluster:
             node_id=node_id, config=self.config, host=self.host, labels=labels,
         )
         self.daemons.append(daemon)
+        # each node becomes a kill target for kill/kill_at rules
+        kill_fn = lambda d=daemon: self.kill_node(d)  # noqa: E731
+        self._chaos_sched.register_kill(daemon.node_id, kill_fn)
+        self._kill_targets.append((daemon.node_id, kill_fn))
         return daemon
 
     def remove_node(self, daemon: NodeDaemon):
@@ -97,6 +112,9 @@ class Cluster:
         raise TimeoutError(f"cluster did not reach {n} nodes")
 
     def shutdown(self):
+        for target, fn in self._kill_targets:
+            self._chaos_sched.unregister_kill(target, fn)
+        self._kill_targets.clear()
         for d in list(self.daemons):
             d.shutdown()
         self.daemons.clear()

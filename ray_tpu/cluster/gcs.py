@@ -17,7 +17,7 @@ import os
 import threading
 import time
 import traceback
-from collections import defaultdict, deque
+from collections import OrderedDict, defaultdict, deque
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -57,6 +57,11 @@ class GcsServer:
         # PENDING-PG retry gate: set when capacity may have changed
         self._pg_retry_needed = True
         self._pg_retry_last = 0.0
+        # dedupe window for retried task_done reports (the retry plane may
+        # resend one after an unanswered window; resource paths dedupe via
+        # the running-table pop, the EVENT log dedupes here). Keyed by the
+        # full report identity — a genuine re-execution has new timestamps.
+        self._taskdone_seen: OrderedDict = OrderedDict()
         # borrow registry (reference: reference_count.cc borrower sets): the
         # owner defers frees while a borrow exists; records here exist so a
         # dead NODE's borrows can be released on its behalf (a dead worker's
@@ -318,6 +323,13 @@ class GcsServer:
 
     def rpc_register_driver(self, p, conn):
         with self._lock:
+            # a reconnecting driver supersedes its old connection's entry
+            # immediately (the old conn's disconnect may land later, or the
+            # conn may be half-dead); stale entries would otherwise win the
+            # _conn_for_driver_id scan and swallow result pushes
+            for cid, d in list(self.drivers.items()):
+                if d.get("driver_id") == p["driver_id"] and cid != conn.conn_id:
+                    del self.drivers[cid]
             self.drivers[conn.conn_id] = {
                 "driver_id": p["driver_id"], "conn": conn,
                 "worker": bool(p.get("worker")),
@@ -386,7 +398,8 @@ class GcsServer:
         holding _lock when possible (only reads drivers table briefly)."""
         with self._lock:
             target = self._driver_conn(
-                conn_id if conn_id is not None else meta.get("owner_conn")
+                conn_id if conn_id is not None else meta.get("owner_conn"),
+                meta.get("owner"),
             )
         if target is None:
             return
@@ -511,8 +524,10 @@ class GcsServer:
             cross_borrow_pushes = []
             task_owner_id = None
             if info is not None:
-                d = self.drivers.get(info.get("owner_conn"))
-                task_owner_id = d.get("driver_id") if d else None
+                task_owner_id = (info.get("meta") or {}).get("owner")
+                if task_owner_id is None:
+                    d = self.drivers.get(info.get("owner_conn"))
+                    task_owner_id = d.get("driver_id") if d else None
             for b in p.get("borrows") or ():
                 self.borrows[(b["id"], p.get("borrow_worker"))] = {
                     "node_id": p["node_id"], "owner": b["owner"],
@@ -526,11 +541,19 @@ class GcsServer:
                             "object_id": b["id"],
                             "worker_id": p.get("borrow_worker"),
                         }))
-            self.task_events.append(
-                {k: p.get(k) for k in ("task_id", "node_id", "status", "name",
-                                       "start", "end", "actor_id")}
-            )
+            seen_key = (p.get("task_id"), p.get("node_id"), p.get("status"),
+                        p.get("start"), p.get("end"))
+            if seen_key not in self._taskdone_seen:
+                self._taskdone_seen[seen_key] = True
+                while len(self._taskdone_seen) > 8192:
+                    self._taskdone_seen.popitem(last=False)
+                self.task_events.append(
+                    {k: p.get(k) for k in ("task_id", "node_id", "status",
+                                           "name", "start", "end",
+                                           "actor_id")}
+                )
             owner_conn = info["owner_conn"] if info else p.get("owner_conn")
+            owner_id = (info.get("meta") or {}).get("owner") if info else None
             alive_actor = None
             kill_on_node = None
             if p.get("actor_creation") and p.get("actor_id"):
@@ -562,7 +585,7 @@ class GcsServer:
                         ) and info is not None and \
                             info.get("meta", {}).get("retries_left", 0) > 0
                         a["state"] = "PENDING" if retryable else "DEAD"
-            target = self._driver_conn(owner_conn)
+            target = self._driver_conn(owner_conn, owner_id)
         for t_conn, payload in cross_borrow_pushes:
             self._push_conn(t_conn, "borrow_added", payload)
         if kill_on_node is not None:
@@ -603,9 +626,17 @@ class GcsServer:
                 pg["bundle_avail"][i] + demand, pg["bundle_total"][i]
             )
 
-    def _driver_conn(self, conn_id):
+    def _driver_conn(self, conn_id, owner_id=None):
+        """Resolve a driver push target. conn_id is the connection a task
+        was submitted on; after a driver reconnect (RetryingRpcClient) that
+        conn is gone, so fall back to routing by the owner's driver id —
+        results must reach the re-registered connection, not the dead one."""
         d = self.drivers.get(conn_id)
-        return d["conn"] if d else None
+        if d is not None:
+            return d["conn"]
+        if owner_id is not None:
+            return self._conn_for_driver_id(owner_id)
+        return None
 
     # --- object directory (reference: ownership_object_directory.cc) ---
 
@@ -723,7 +754,12 @@ class GcsServer:
             self.directory[p["object_id"]].add(p["node_id"])
             ready = self._on_object_added(p["object_id"])
             info = self.running.get(p["task_id"])
-            owner = self._driver_conn(info["owner_conn"]) if info else None
+            owner = (
+                self._driver_conn(
+                    info["owner_conn"], (info.get("meta") or {}).get("owner")
+                )
+                if info else None
+            )
         if ready:
             self._kick()
         if owner is not None:
@@ -1014,7 +1050,7 @@ class GcsServer:
                 return c
             addr, port = n["addr"], n["port"]
         try:
-            c = RpcClient(addr, port)
+            c = RpcClient(addr, port, name="gcs", peer=node_id)
         except OSError:
             return None
         with self._lock:
@@ -1397,7 +1433,7 @@ class GcsServer:
         for node_id, ts in by_node.items():
             self._push_to_node(node_id, "exec_tasks", ts)
         for t, reason in failed:
-            target = self._driver_conn(t.get("owner_conn"))
+            target = self._driver_conn(t.get("owner_conn"), t.get("owner"))
             if target is not None:
                 payload = {"task_id": t["task_id"], "status": "UNSCHEDULABLE",
                            "error": reason}
@@ -1601,7 +1637,14 @@ class GcsServer:
         if driver_id:
             with self._lock:
                 self.drivers.pop(conn.conn_id, None)
-                if driver_id in self.jobs:
+                # a RetryingRpcClient reconnect re-registers on a NEW conn
+                # before (or after) the old conn's disconnect lands — only
+                # a driver with no surviving connection ends its job
+                still_here = any(
+                    d.get("driver_id") == driver_id
+                    for d in self.drivers.values()
+                )
+                if not still_here and driver_id in self.jobs:
                     self.jobs[driver_id]["state"] = "FINISHED"
 
     def _health_loop(self):
@@ -1781,7 +1824,7 @@ class GcsServer:
             if meta.get("actor_creation") and \
                     meta.get("actor_id") in restarted_actor_ids:
                 continue
-            target = self._driver_conn(info["owner_conn"])
+            target = self._driver_conn(info["owner_conn"], meta.get("owner"))
             if target is not None:
                 payload = {
                     "task_id": tid, "status": "NODE_DIED", "node_id": node_id,

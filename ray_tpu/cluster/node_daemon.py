@@ -26,7 +26,13 @@ from typing import Any, Dict, List, Optional
 
 from ray_tpu.core.config import Config
 from ray_tpu.core.task_spec import new_id
-from ray_tpu.cluster.rpc import RpcClient, RpcServer, log_rpc_failure
+from ray_tpu.cluster.rpc import (
+    ConnectionLost,
+    RetryingRpcClient,
+    RpcClient,
+    RpcServer,
+    log_rpc_failure,
+)
 
 
 class ObjectStore:
@@ -251,7 +257,32 @@ class NodeDaemon:
         self._gcs_addr = gcs_addr
         self._labels = dict(labels or {})
         self._nodes_snapshot: Dict[str, dict] = {}
-        self.gcs = self._connect_gcs()
+        # Auto-reconnecting GCS session (reference: raylet reconnect +
+        # resubscribe after GCS fault-tolerant restart): registration and
+        # resync live in _gcs_session, replayed on every reconnect, so a
+        # GCS restart is survivable at any point in the daemon's life.
+        # Published on self BEFORE connect(): a task pushed the instant
+        # register_node lands may hit handlers (e.g. _spawn_worker ->
+        # self.gcs.host) while connect() is still on the stack.
+        self.gcs = RetryingRpcClient(
+            gcs_addr[0], gcs_addr[1], name=self.node_id, peer="gcs",
+            on_session=self._gcs_session, auto_connect=False,
+            config=self.config,
+        )
+        self.gcs.subscribe("exec_task", self._on_exec_task)
+        self.gcs.subscribe("exec_tasks", self._on_exec_tasks)
+        self.gcs.subscribe("kill_actor", self._on_kill_actor)
+        self.gcs.subscribe(
+            "free_objects", lambda p: self.store.delete(p["object_ids"])
+        )
+        self.gcs.subscribe(
+            "return_bundle",
+            lambda p: self._bundles.pop(
+                f"{p['pg_id']}:{p['bundle_index']}", None
+            ),
+        )
+        self.gcs.subscribe("nodes", self._on_nodes_update)
+        self.gcs.connect()
         self._beat_thread = threading.Thread(
             target=self._heartbeat_loop, daemon=True, name="daemon-beat"
         )
@@ -259,74 +290,33 @@ class NodeDaemon:
 
     # ------------------------------------------------- GCS (re)connection
 
-    def _connect_gcs(self) -> RpcClient:
-        gcs = RpcClient(self._gcs_addr[0], self._gcs_addr[1])
-        # Publish the client on self BEFORE subscribing: a task pushed the
-        # instant register_node lands would otherwise hit handlers (e.g.
-        # _spawn_worker -> self.gcs.host) before __init__'s assignment runs.
-        self.gcs = gcs
-        gcs.subscribe("exec_task", self._on_exec_task)
-        gcs.subscribe("exec_tasks", self._on_exec_tasks)
-        gcs.subscribe("kill_actor", self._on_kill_actor)
-        gcs.subscribe("free_objects", lambda p: self.store.delete(p["object_ids"]))
-        gcs.subscribe(
-            "return_bundle",
-            lambda p: self._bundles.pop(
-                f"{p['pg_id']}:{p['bundle_index']}", None
-            ),
-        )
-        gcs.subscribe("nodes", self._on_nodes_update)
-        gcs.on_close = self._on_gcs_lost
+    def _gcs_session(self, gcs: RpcClient, first: bool):
+        """(Re)establish this node's GCS session on a fresh connection:
+        register, then on reconnects re-sync hosted actors and stored
+        objects into the rebuilt tables (snapshot + O(delta) recovery on
+        the GCS side)."""
+        if self._stopped:
+            # stop() raced a reconnect: a stopping daemon must not
+            # resurrect itself (it would re-register as alive with its
+            # store contents, then silently heartbeat-timeout again)
+            raise ConnectionLost("daemon stopping")
+        timeout = self.config.rpc_call_timeout_s
         reply = gcs.call("register_node", {
             "node_id": self.node_id, "addr": self.host, "port": self.port,
             "resources": self.resources, "labels": self._labels,
             "shm_name": self.shm_name,
-        })
+        }, timeout=timeout)
         assert reply["ok"]
-        return gcs
-
-    def _on_gcs_lost(self):
-        """GCS connection dropped: reconnect + re-sync (reference: raylet
-        reconnect/resubscribe after GCS fault-tolerant restart)."""
-        if self._stopped:
-            return
-        threading.Thread(
-            target=self._gcs_reconnect_loop, daemon=True,
-            name="daemon-gcs-reconnect",
-        ).start()
-
-    def _gcs_reconnect_loop(self):
-        deadline = time.time() + self.config.gcs_reconnect_timeout_s
-        while not self._stopped and time.time() < deadline:
-            time.sleep(0.2)
-            try:
-                gcs = self._connect_gcs()
-            except OSError:
-                continue
-            if self._stopped:
-                # stop() raced the reconnect: a stopping daemon must not
-                # resurrect itself (it would re-register as alive with its
-                # store contents, then silently heartbeat-timeout again)
-                try:
-                    gcs.close()
-                except Exception:  # noqa: BLE001
-                    pass
-                return
-            # re-sync node-local state into the fresh GCS tables
+        if not first:
             with self._lock:
                 actor_ids = [
                     w.actor_id for w in self.workers.values() if w.actor_id
                 ]
-            try:
-                gcs.call("node_sync", {
-                    "node_id": self.node_id,
-                    "actor_ids": actor_ids,
-                    "object_ids": self.store.object_ids(),
-                })
-            except Exception:
-                pass
-            self.gcs = gcs
-            return
+            gcs.call("node_sync", {
+                "node_id": self.node_id,
+                "actor_ids": actor_ids,
+                "object_ids": self.store.object_ids(),
+            }, timeout=timeout)
 
     # ------------------------------------------------------------ worker pool
 
@@ -471,10 +461,13 @@ class NodeDaemon:
                     error=f"actor worker died (exit {w.proc.poll()})",
                 )
             try:
-                self.gcs.call("actor_died", {
+                # async: this handler runs on the daemon's event loop (the
+                # server's on_disconnect hook) — a blocking GCS round trip
+                # here would stall all daemon rpc handling
+                self.gcs.call_async("actor_died", {
                     "actor_id": w.actor_id,
                     "cause": f"worker process died (exit {w.proc.poll()})",
-                })
+                }).add_done_callback(log_rpc_failure)
             except Exception:
                 pass
 
@@ -658,9 +651,11 @@ class NodeDaemon:
         if hasattr(self.store, "note"):
             self.store.note(p["object_id"])
         try:
-            self.gcs.call("add_object_location", {
+            # async: rpc handlers run on the event loop; the location
+            # publish must not block it on a GCS round trip
+            self.gcs.call_async("add_object_location", {
                 "object_id": p["object_id"], "node_id": self.node_id,
-            })
+            }).add_done_callback(log_rpc_failure)
         except Exception:
             pass
         return {"ok": True}
@@ -668,9 +663,9 @@ class NodeDaemon:
     def rpc_put_object(self, p, conn):
         self.store.put(p["object_id"], p["payload"])
         try:
-            self.gcs.call("add_object_location", {
+            self.gcs.call_async("add_object_location", {
                 "object_id": p["object_id"], "node_id": self.node_id,
-            })
+            }).add_done_callback(log_rpc_failure)
         except Exception:
             pass
         return {"ok": True}
@@ -872,9 +867,11 @@ class NodeDaemon:
             self._actor_tasks.pop(task_id, None)
             for oid, _ in payload["results"]:
                 try:
-                    self.gcs.call("add_object_location", {
+                    # _report_done runs on the event loop for actor calls
+                    # too — publish locations without blocking it
+                    self.gcs.call_async("add_object_location", {
                         "object_id": oid, "node_id": self.node_id,
-                    })
+                    }).add_done_callback(log_rpc_failure)
                 except Exception:
                     pass
             return
@@ -921,7 +918,10 @@ class NodeDaemon:
                 continue  # puller failed; take over on the next lap
             try:
                 try:
-                    loc = self.gcs.call("locate_object", {"object_id": oid})
+                    loc = self.gcs.call(
+                        "locate_object", {"object_id": oid},
+                        timeout=self.config.rpc_call_timeout_s,
+                    )
                 except Exception:
                     return False
                 for entry in loc.get("nodes", []):
@@ -938,7 +938,7 @@ class NodeDaemon:
                         try:
                             self.gcs.call("add_object_location", {
                                 "object_id": oid, "node_id": self.node_id,
-                            })
+                            }, timeout=self.config.rpc_call_timeout_s)
                         except Exception:
                             pass
                         return True
@@ -1056,7 +1056,7 @@ class NodeDaemon:
             if c is not None and not c._closed:
                 return c
         try:
-            c = RpcClient(addr, port)
+            c = RpcClient(addr, port, name=self.node_id, peer=node_id)
         except OSError:
             return None
         with self._lock:

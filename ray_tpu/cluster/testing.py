@@ -87,10 +87,16 @@ def run_rounds_to_quiescence(
         _time.monotonic() + time_budget_s
         if time_budget_s is not None else None
     )
+    from ray_tpu.cluster import rpc as _rpc
+
     placements: Dict[str, str] = {}
     for _ in range(max_rounds):
         if deadline is not None and _time.monotonic() > deadline:
             break
+        if _rpc.CHAOS is not None:
+            # kill-at-step hook: seeded schedules can kill a registered
+            # process on an exact manually-driven scheduling round
+            _rpc.CHAOS.step("sched_round")
         gcs._schedule_round()
         with gcs._lock:
             for tid, info in gcs.running.items():

@@ -410,7 +410,10 @@ def main():  # pragma: no cover - runs as a subprocess
     port = int(os.environ["RAY_TPU_DAEMON_PORT"])
     worker_id = os.environ["RAY_TPU_WORKER_ID"]
     try:
-        client = RpcClient(host, port, timeout=120.0)
+        client = RpcClient(
+            host, port, timeout=120.0,
+            name=worker_id, peer=os.environ.get("RAY_TPU_NODE_ID", "daemon"),
+        )
     except OSError:
         # daemon already gone (cluster tearing down while we spawned):
         # exit quietly instead of spraying a traceback
@@ -427,7 +430,8 @@ def main():  # pragma: no cover - runs as a subprocess
     import ray_tpu
 
     ray_tpu.init(ignore_reinit_error=True)
-    client.call("worker_ready", {"worker_id": worker_id, "pid": os.getpid()})
+    client.call("worker_ready", {"worker_id": worker_id, "pid": os.getpid()},
+                timeout=30.0)
     # Threaded-actor pool (reference: max_concurrency>1): methods of an actor
     # created with max_concurrency>1 may overlap/block on each other.
     from concurrent.futures import ThreadPoolExecutor

@@ -54,9 +54,26 @@ _DEFS: Dict[str, tuple] = {
     "object_fetch_timeout_s": (float, 10.0),
     "memory_monitor_interval_ms": (float, 500.0),
     "gcs_port": (int, 0),  # 0 -> pick free port
-    # daemons/drivers retry re-connecting to a restarted GCS for this long
-    # (reference: gcs_rpc_server_reconnect_timeout_s)
+    # outage window before RetryingRpcClient fires on_reconnect_timeout
+    # (drivers fail stranded tasks then) — reconnection itself keeps
+    # retrying past it, so a GCS back after minutes still restores the
+    # session (reference: gcs_rpc_server_reconnect_timeout_s)
     "gcs_reconnect_timeout_s": (float, 30.0),
+    # --- rpc layer (cluster/rpc.py; reference: the grpc deadline/retry
+    # knobs around retryable_grpc_client.cc) ---
+    "rpc_call_timeout_s": (float, 30.0),  # default blocking-call deadline
+    # per-frame socket send deadline: a peer that stops draining its
+    # receive buffer wedges senders at most this long (then ConnectionLost)
+    "rpc_send_timeout_s": (float, 30.0),
+    "rpc_server_start_timeout_s": (float, 10.0),
+    "rpc_server_stop_timeout_s": (float, 3.0),
+    # RetryingRpcClient backoff: full jitter over
+    # [0, min(max_backoff, base * 2^attempt)]
+    "rpc_retry_base_backoff_s": (float, 0.05),
+    "rpc_retry_max_backoff_s": (float, 2.0),
+    # sub-deadline per retryable attempt (a lost frame costs one attempt
+    # window, not the whole call budget)
+    "rpc_retry_attempt_timeout_s": (float, 5.0),
     "num_workers_soft_limit": (int, 0),  # 0 -> num_cpus
     "worker_start_timeout_s": (float, 30.0),
     "metrics_report_interval_ms": (float, 2000.0),

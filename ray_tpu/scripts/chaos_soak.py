@@ -1,29 +1,77 @@
-"""Chaos soak: continuous task/actor/PG load under node churn.
+"""Chaos soak: continuous task/actor/PG load under a seeded fault plane.
 
 Not a pytest test (runtime is minutes by design): run as
-    python -m ray_tpu.scripts.chaos_soak [seconds]
+    python -m ray_tpu.scripts.chaos_soak [--seed N] [--duration S]
 and read the rolling stats. Every task result is value-checked; "errors"
 must stay 0 — expected_actor_errs counts actor calls in flight at a node
-kill (at-most-once semantics, reference behavior). Last recorded run
-(2026-07-30, 1-core host): 580s, 5278 tasks, 2137 actor calls, 539 PGs,
-379 node kills, 0 task errors.
+kill (at-most-once semantics, reference behavior).
+
+The fault plane is a ray_tpu.chaos.FaultSchedule: node kills fire from
+seeded kill rules consulted once per loop iteration (the step() hook), and
+frame-level faults (driver->GCS resets, daemon->GCS drops) ride the RPC
+hook points. The workload mix is driven by the same seed, so two runs with
+one seed replay the same soak — compare their sched.trace_text() to verify.
+Last recorded run (2026-08-02, 2-core host, seed 7): 120s, 907 tasks, 336
+actor calls, 85 PGs, 56 node kills, 0 task errors.
 """
-import os, random, sys, time
+import argparse
+import random
+import time
+
 import numpy as np
+
 import ray_tpu
+from ray_tpu import chaos
 from ray_tpu.cluster.cluster_utils import Cluster
 
-DURATION = float(sys.argv[1]) if len(sys.argv) > 1 else 600.0
-random.seed(7)
+ap = argparse.ArgumentParser(description=__doc__)
+ap.add_argument("--seed", type=int, default=7,
+                help="fault-schedule + workload seed (same seed = same soak)")
+ap.add_argument("--duration", type=float, default=600.0, help="seconds")
+args = ap.parse_args()
+
+rng = random.Random(args.seed)  # workload mix (tasks vs actors vs PGs)
+sched = chaos.install(chaos.FaultSchedule(seed=args.seed, rules=[
+    # ~1 node kill per 25 loop iterations, deterministic per seed
+    chaos.kill(label="soak", p=0.04, target="churn"),
+    # occasional driver->GCS resets exercise the reconnect plane
+    chaos.reset(src="driver-*", dst="gcs", p=0.002, hook="client_send"),
+    # lossy daemon->GCS link exercises call retries
+    chaos.drop(src="node-*", dst="gcs", p=0.001, hook="client_send"),
+]))
 
 cluster = Cluster()
 stable = cluster.add_node(num_cpus=2, node_id="stable")
-churn_nodes = [cluster.add_node(num_cpus=2) for _ in range(2)]
+for _ in range(2):
+    cluster.add_node(num_cpus=2)
+
+
+_kill_lock = __import__("threading").Lock()
+
+
+def kill_one_churn_node():
+    # each fired kill rule runs on its own thread; overlapping invocations
+    # would double-kill one victim and over-grow the replacement pool
+    if not _kill_lock.acquire(blocking=False):
+        return
+    try:
+        victims = [d for d in cluster.daemons if d.node_id != "stable"]
+        if len(victims) < 2:
+            return  # keep at least one churn node alive for in-flight work
+        cluster.kill_node(victims[0])
+        stats["kills"] += 1
+        time.sleep(0.5)
+        cluster.add_node(num_cpus=2)
+    finally:
+        _kill_lock.release()
+
+
+sched.register_kill("churn", kill_one_churn_node)
 ray_tpu.init(address=cluster.address)
 
 @ray_tpu.remote(max_retries=8)
 def work(i, payload):
-    time.sleep(random.random() * 0.05)
+    time.sleep(0.02)
     return int(payload.sum()) + i
 
 @ray_tpu.remote(max_restarts=-1)
@@ -34,7 +82,7 @@ class Counter:
 from ray_tpu.util.placement_group import placement_group, remove_placement_group
 
 actors = [Counter.remote() for _ in range(4)]
-t_end = time.time() + DURATION
+t_end = time.time() + args.duration
 stats = {"tasks": 0, "actor_calls": 0, "pgs": 0, "kills": 0, "errors": 0,
          "expected_actor_errs": 0}
 last_report = time.time()
@@ -43,24 +91,19 @@ pending = []
 i = 0
 while time.time() < t_end:
     i += 1
-    r = random.random()
+    sched.step("soak")  # kill-at-step hook: seeded node churn
+    r = rng.random()
     try:
-        if r < 0.55:
+        if r < 0.6:
             pending.append(("task", work.remote(i, payload), i))
-        elif r < 0.8:
-            a = random.choice(actors)
+        elif r < 0.85:
+            a = rng.choice(actors)
             pending.append(("actor", a.add.remote(1), None))
-        elif r < 0.86:
+        elif r < 0.91:
             pg = placement_group([{"CPU": 1}], strategy="PACK")
             pg.ready(timeout=10)
             remove_placement_group(pg)
             stats["pgs"] += 1
-        elif r < 0.9 and len(cluster.daemons) > 1:
-            victim = random.choice([d for d in cluster.daemons if d.node_id != "stable"])
-            cluster.kill_node(victim)
-            stats["kills"] += 1
-            time.sleep(0.5)
-            cluster.add_node(num_cpus=2)
         # drain some pending
         while len(pending) > 60:
             kind, ref, arg = pending.pop(0)
@@ -81,7 +124,9 @@ while time.time() < t_end:
         stats["errors"] += 1
         print("LOOP ERROR:", repr(e)[:200], flush=True)
     if time.time() - last_report > 30:
-        print("t=%.0fs %s pending=%d" % (DURATION - (t_end - time.time()), stats, len(pending)), flush=True)
+        print("t=%.0fs %s pending=%d" % (
+            args.duration - (t_end - time.time()), stats, len(pending)
+        ), flush=True)
         last_report = time.time()
 
 for kind, ref, arg in pending:
@@ -96,5 +141,7 @@ for kind, ref, arg in pending:
 print("FINAL:", stats, flush=True)
 totals = [ray_tpu.get(a.add.remote(0), timeout=60) for a in actors]
 print("actor totals:", totals, flush=True)
-ray_tpu.shutdown(); cluster.shutdown()
+print("fault trace (%d faults):" % len(sched.trace()), flush=True)
+print(sched.trace_text(), flush=True)
+ray_tpu.shutdown(); cluster.shutdown(); chaos.uninstall()
 print("SOAK DONE; task errors:", stats["errors"], flush=True)

@@ -55,6 +55,7 @@ def test_plugin_table_has_all_checkers():
         "unawaited-coroutine",
         "dropped-object-ref",
         "resource-spec-validation",
+        "unbounded-rpc-call",
     }
     for cls in CHECKERS.values():
         assert cls.description
@@ -753,6 +754,97 @@ def test_valid_options_match_runtime_api():
     from ray_tpu.core import api
 
     assert _VALID_OPTIONS == api._VALID_OPTIONS
+
+
+# ========================================================== unbounded-rpc-call
+
+
+def lint_cluster(tmp_path, source, name="snippet.py"):
+    """Write the snippet under a cluster/ dir: unbounded-rpc-call scopes
+    itself to control-plane paths."""
+    d = tmp_path / "cluster"
+    d.mkdir(exist_ok=True)
+    (d / name).write_text(textwrap.dedent(source))
+    res = analyze_paths([str(tmp_path)], root=str(tmp_path),
+                        select=["unbounded-rpc-call"])
+    assert not res.errors, res.errors
+    return res
+
+
+def test_unbounded_rpc_call_fires_in_cluster_path(tmp_path):
+    res = lint_cluster(
+        tmp_path,
+        """
+        def beat(gcs):
+            gcs.call("heartbeat", {"node_id": "n"})
+        """,
+    )
+    assert checks(res) == ["unbounded-rpc-call"]
+    assert "heartbeat" in res.findings[0].message
+    assert "timeout" in res.findings[0].message
+
+
+def test_unbounded_rpc_call_clean_with_timeout(tmp_path):
+    res = lint_cluster(
+        tmp_path,
+        """
+        def beat(gcs, cfg):
+            gcs.call("heartbeat", {"node_id": "n"}, timeout=5.0)
+            gcs.call("locate_object", {"object_id": "o"},
+                     timeout=cfg.rpc_call_timeout_s)
+        """,
+    )
+    assert res.findings == []
+
+
+def test_unbounded_rpc_call_ignores_non_rpc_call(tmp_path):
+    """`.call(x)` with a non-literal first arg is not the rpc idiom
+    (e.g. an actor event-loop helper dispatching by method name)."""
+    res = lint_cluster(
+        tmp_path,
+        """
+        def run(aio, method, args):
+            return aio.call(method, args)
+        """,
+    )
+    assert res.findings == []
+
+
+def test_unbounded_rpc_call_scoped_to_control_plane(tmp_path):
+    """The same unbounded call OUTSIDE a control-plane dir is not flagged
+    (driver scripts may reasonably ride client defaults)."""
+    (tmp_path / "userland.py").write_text(textwrap.dedent(
+        """
+        def beat(gcs):
+            gcs.call("heartbeat", {"node_id": "n"})
+        """
+    ))
+    res = analyze_paths([str(tmp_path / "userland.py")], root=str(tmp_path),
+                        select=["unbounded-rpc-call"])
+    assert res.findings == []
+
+
+def test_unbounded_rpc_call_pragma_suppresses(tmp_path):
+    res = lint_cluster(
+        tmp_path,
+        """
+        def beat(gcs):
+            gcs.call("heartbeat", {})  # ray-lint: disable=unbounded-rpc-call
+        """,
+    )
+    assert res.findings == []
+    assert res.suppressed == 1
+
+
+def test_cluster_tree_has_no_unbounded_rpc_calls():
+    """Repo gate for the new checker specifically: every blocking rpc in
+    ray_tpu/cluster/ carries an explicit deadline (fixed, not baselined)."""
+    res = analyze_paths(
+        [os.path.join(REPO, "ray_tpu", "cluster")],
+        root=REPO,
+        select=["unbounded-rpc-call"],
+    )
+    assert res.findings == [], [f.format() for f in res.findings]
 
 
 # ============================================================= pragmas/baseline

@@ -486,6 +486,132 @@ def test_consumer_task_waits_for_inflight_actor_result():
         cluster.shutdown()
 
 
+def _echo_server():
+    from ray_tpu.cluster.rpc import RpcServer
+
+    server = RpcServer(lambda method, params, conn: params, name="gcs")
+    server.start()
+    return server
+
+
+def test_subscriptions_replayed_exactly_once_after_reset():
+    """Satellite: RetryingRpcClient re-registers its _subs on the NEW
+    connection after a reset — pushes sent post-reconnect arrive exactly
+    once (a stacked re-subscribe would deliver duplicates; a missed replay
+    would deliver nothing)."""
+    from ray_tpu.cluster.rpc import RetryingRpcClient
+
+    server = _echo_server()
+    client = RetryingRpcClient(
+        "127.0.0.1", server.port, name="driver-sub", peer="gcs",
+        reconnect_timeout_s=15,
+    )
+    got = []
+    try:
+        client.subscribe("tick", got.append)
+        # the server registers the accepted conn on its loop; broadcast
+        # only reaches registered conns, so wait for it to appear
+        deadline = time.time() + 10
+        while time.time() < deadline and not server.conns:
+            time.sleep(0.02)
+        assert server.conns, "server never registered the connection"
+        server.broadcast("tick", 1)
+        deadline = time.time() + 10
+        while time.time() < deadline and not got:
+            time.sleep(0.02)
+        assert got == [1]
+
+        # injected reset: abort the server side of the connection
+        old_conns = set(server.conns)
+        for conn in list(server.conns.values()):
+            server.call_soon(conn.writer.transport.abort)
+        # the client reconnects as a NEW server conn
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if set(server.conns) - old_conns:
+                break
+            time.sleep(0.05)
+        assert set(server.conns) - old_conns, "client never reconnected"
+
+        server.broadcast("tick", 2)
+        deadline = time.time() + 10
+        while time.time() < deadline and len(got) < 2:
+            time.sleep(0.02)
+        time.sleep(0.3)  # would catch a duplicate delivery
+        assert got == [1, 2], got
+        # the reconnected session still answers calls
+        assert client.call("kv_get", {"k": 1}, timeout=10) == {"k": 1}
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_retrying_client_survives_full_server_restart():
+    """Blocking calls of retryable methods issued DURING the outage wait
+    for the reconnect (capped backoff + jitter) and then complete against
+    the replacement server."""
+    import threading
+
+    from ray_tpu.cluster.rpc import RetryingRpcClient
+
+    server = _echo_server()
+    port = server.port
+    client = RetryingRpcClient(
+        "127.0.0.1", port, name="driver-rst", peer="gcs",
+        reconnect_timeout_s=30,
+    )
+    try:
+        assert client.call("kv_get", {"v": 0}, timeout=10) == {"v": 0}
+        server.stop()
+        result = {}
+
+        def _blocked_call():
+            # issued mid-outage; must block-and-retry, not fail fast
+            result["v"] = client.call("kv_get", {"v": 1}, timeout=30)
+
+        t = threading.Thread(target=_blocked_call, daemon=True)
+        t.start()
+        time.sleep(0.5)
+        from ray_tpu.cluster.rpc import RpcServer
+
+        server = RpcServer(
+            lambda method, params, conn: params, name="gcs", port=port
+        )
+        server.start()
+        t.join(timeout=30)
+        assert not t.is_alive(), "call never completed after server restart"
+        assert result.get("v") == {"v": 1}
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_call_async_queued_during_outage_resolves_after_reconnect():
+    """Fire-and-forget futures (task_done, submit_task, ...) issued while
+    the GCS is down park in the reconnect queue and resolve after replay
+    — event-loop threads are never blocked by a dead peer."""
+    from ray_tpu.cluster.rpc import RpcServer, RetryingRpcClient
+
+    server = _echo_server()
+    port = server.port
+    client = RetryingRpcClient(
+        "127.0.0.1", port, name="node-q", peer="gcs", reconnect_timeout_s=30,
+    )
+    try:
+        server.stop()
+        time.sleep(0.3)
+        fut = client.call_async("task_done", {"task_id": "t1"})
+        assert not fut.done(), "future failed instead of parking"
+        server = RpcServer(
+            lambda method, params, conn: params, name="gcs", port=port
+        )
+        server.start()
+        assert fut.result(timeout=30) == {"task_id": "t1"}
+    finally:
+        client.close()
+        server.stop()
+
+
 def test_consumer_fails_cleanly_when_actor_dies_before_producing():
     """If the vouched-for actor dies before producing, the owner publishes
     the error AS the object — the parked consumer raises instead of
