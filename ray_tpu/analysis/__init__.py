@@ -1,14 +1,21 @@
-"""ray_tpu.analysis — distributed-correctness linter + concurrency sanitizer.
+"""ray_tpu.analysis — distributed-correctness linter + runtime sanitizers.
 
 Static half: ``python -m ray_tpu.analysis <paths>`` runs the AST checkers
 registered in :mod:`ray_tpu.analysis.checkers` (blocking-in-async,
 unsafe-closure-capture, lock-order-cycle, unawaited-coroutine,
-dropped-object-ref, resource-spec-validation) with per-line
-``# ray-lint: disable=<check>`` pragmas and a committed ratchet baseline.
+dropped-object-ref, resource-spec-validation, unbounded-rpc-call, plus
+the protocol checkers over :mod:`ray_tpu.analysis.protocol`'s extracted
+RPC model: rpc-method-unknown, rpc-payload-key-mismatch,
+push-topic-unknown, config-key-unknown) with per-line
+``# ray-lint: disable=<check>`` pragmas and a committed ratchet
+baseline. ``--dump-protocol`` emits the protocol model as JSON.
 
-Runtime half: :class:`ray_tpu.analysis.sanitizer.LockOrderSanitizer`, an
-instrumented-lock shim recording observed lock orderings (opt in from
-tests via the ``lock_sanitizer`` fixture) to cross-check the static graph.
+Runtime half: :class:`ray_tpu.analysis.sanitizer.LockOrderSanitizer`
+(instrumented-lock shim cross-checking the static lock graph via the
+``lock_sanitizer`` fixture) and :mod:`ray_tpu.analysis.invariants`
+(Lamport-clocked protocol tracer + offline happens-before invariant
+checker, ``invariant_sanitizer`` fixture / ``--check-trace``) — each
+runtime sanitizer is the dynamic cross-check of its static model.
 
 Deliberately imports no runtime module (jax, numpy, the cluster stack):
 linting must work in any environment the source parses in.

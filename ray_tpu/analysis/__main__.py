@@ -36,6 +36,15 @@ def main(argv=None) -> int:
     p.add_argument("--select", default=None, metavar="CHECKS",
                    help="comma-separated subset of checks to run")
     p.add_argument("--list-checks", action="store_true")
+    p.add_argument("--dump-protocol", action="store_true",
+                   help="instead of linting, emit the extracted RPC "
+                        "protocol model (handlers, call sites, push/"
+                        "subscribe topics, config knobs) as JSON")
+    p.add_argument("--check-trace", default=None, metavar="TRACE",
+                   help="instead of linting, replay a protocol trace "
+                        "(JSONL from the invariant sanitizer) and verify "
+                        "the happens-before invariants; exit 1 on "
+                        "violations")
     args = p.parse_args(argv)
 
     # Import for side effect: populate the registry before --list-checks.
@@ -44,6 +53,35 @@ def main(argv=None) -> int:
     if args.list_checks:
         for name in sorted(CHECKERS):
             print(f"{name}: {CHECKERS[name].description}")
+        return 0
+
+    if args.check_trace is not None:
+        from ray_tpu.analysis.invariants import check_trace
+
+        try:
+            violations = check_trace(args.check_trace)
+        except (OSError, ValueError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        for v in violations:
+            print(v.format())
+        print(f"{len(violations)} invariant violation(s)")
+        return 1 if violations else 0
+
+    if args.dump_protocol:
+        from ray_tpu.analysis.protocol import extract_protocol
+
+        paths = [p_ for p_ in args.paths if os.path.exists(p_)]
+        missing = [p_ for p_ in args.paths if not os.path.exists(p_)]
+        if missing or not paths:
+            print(f"error: no such path(s): {missing}", file=sys.stderr)
+            return 2
+        try:
+            idx = extract_protocol(paths)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        print(json.dumps(idx.to_dict(), indent=2))
         return 0
 
     select = (

@@ -1110,6 +1110,178 @@ class UnboundedRpcCallChecker(Checker):
         return out
 
 
+# ------------------------------------------------------ protocol checkers
+#
+# Four whole-program checks over the ProtocolIndex (analysis/protocol.py):
+# the stringly-typed control plane gets the cross-referencing a generated
+# gRPC stub would give the reference. Each checker builds the index during
+# check_module and emits from finalize; every check self-gates on having
+# seen the relevant counterpart surface (handlers, subscriptions, the
+# config table) so linting a single file never false-positives.
+
+
+class _ProtocolCheckerBase(Checker):
+    def __init__(self):
+        from ray_tpu.analysis.protocol import ProtocolIndex
+
+        self.index = ProtocolIndex()
+
+    def check_module(self, ctx: ModuleContext) -> List[Finding]:
+        from ray_tpu.analysis.protocol import ProtocolIndex
+
+        # the per-module AST extraction is cached on the ctx: all four
+        # protocol checkers share one walk per file, merging cheap lists
+        self.index.merge(ProtocolIndex.piece_for(ctx))
+        return []
+
+    @staticmethod
+    def _site_finding(site, check: str, message: str) -> Finding:
+        return Finding(
+            path=site.path,
+            line=site.line,
+            col=0,
+            check=check,
+            message=message,
+            line_text=site.line_text,
+            end_line=site.end_line,
+        )
+
+
+@register
+class RpcMethodUnknownChecker(_ProtocolCheckerBase):
+    name = "rpc-method-unknown"
+    description = (
+        "`.call/.call_async/.notify(\"method\", ...)` whose string-literal "
+        "method has NO `rpc_<method>` handler anywhere in the scanned tree "
+        "— a typo'd or renamed rpc fails only at runtime with 'unknown "
+        "method'"
+    )
+
+    def finalize(self) -> List[Finding]:
+        known = self.index.handler_methods()
+        if not known:
+            return []  # no handler surface in scope: nothing to check against
+        out: List[Finding] = []
+        for site in self.index.calls:
+            if site.method not in known:
+                out.append(self._site_finding(
+                    site, self.name,
+                    f"rpc `{site.method}` has no rpc_{site.method} handler "
+                    f"in the scanned tree (known methods: "
+                    f"{len(known)}); typo, rename drift, or a handler "
+                    "outside the scan",
+                ))
+        return out
+
+
+@register
+class RpcPayloadKeyMismatchChecker(_ProtocolCheckerBase):
+    name = "rpc-payload-key-mismatch"
+    description = (
+        "literal payload-dict keys at a call site disagree with the "
+        "`p[\"...\"]`/`p.get(\"...\")` keys the handler reads: a missing "
+        "required key is a guaranteed KeyError in the handler; a key no "
+        "handler ever reads is dead weight or rename drift"
+    )
+
+    def finalize(self) -> List[Finding]:
+        out: List[Finding] = []
+        for site in self.index.calls:
+            if site.keys is None:
+                continue  # payload is a variable/absent: uncheckable here
+            candidates = self.index.handlers.get(site.method)
+            if not candidates:
+                continue  # rpc-method-unknown owns that case
+            keys = set(site.keys)
+            if not site.open_keys:
+                # required keys: satisfied if ANY candidate handler's
+                # required set is covered (methods like stream_item exist
+                # on both gcs and daemon with different contracts)
+                missing_per = [(h, h.required - keys) for h in candidates]
+                if all(miss for _h, miss in missing_per):
+                    h, miss = min(missing_per, key=lambda t: len(t[1]))
+                    out.append(self._site_finding(
+                        site, self.name,
+                        f"rpc `{site.method}` payload is missing key(s) "
+                        f"{sorted(miss)} that {h.path}:{h.line} reads as "
+                        "required `p[\"...\"]`",
+                    ))
+            if all(not h.open_payload for h in candidates):
+                readable = set()
+                for h in candidates:
+                    readable |= h.required | h.optional
+                dead = sorted(keys - readable)
+                if dead:
+                    out.append(self._site_finding(
+                        site, self.name,
+                        f"rpc `{site.method}` payload key(s) {dead} are "
+                        "never read by any handler — dead weight or a "
+                        "renamed key the handler no longer sees",
+                    ))
+        return out
+
+
+@register
+class PushTopicUnknownChecker(_ProtocolCheckerBase):
+    name = "push-topic-unknown"
+    description = (
+        "a push/broadcast topic literal that no `.subscribe(\"topic\")` in "
+        "the scanned tree listens to: the frame is built, sent, and "
+        "silently dropped at every client"
+    )
+
+    def finalize(self) -> List[Finding]:
+        subscribed = self.index.subscribed_topics()
+        if not subscribed:
+            return []  # no subscriber surface in scope
+        out: List[Finding] = []
+        for site in self.index.pushes:
+            if site.topic not in subscribed:
+                out.append(self._site_finding(
+                    site, self.name,
+                    f"push topic `{site.topic}` has no subscriber in the "
+                    "scanned tree — every delivery is silently dropped",
+                ))
+        return out
+
+
+@register
+class ConfigKeyUnknownChecker(_ProtocolCheckerBase):
+    name = "config-key-unknown"
+    description = (
+        "a config-knob usage (attribute read on a Config/GLOBAL_CONFIG, an "
+        "override-dict key, or a literal RAY_TPU_<lowercase> env name) "
+        "that core/config.py's _DEFS table does not define: reads raise "
+        "AttributeError at runtime, overrides raise ValueError, env knobs "
+        "are silently ignored"
+    )
+
+    def finalize(self) -> List[Finding]:
+        from ray_tpu.analysis.protocol import CONFIG_API_ATTRS
+
+        defined = self.index.config_keys
+        if not defined:
+            return []  # _DEFS not in scope: nothing to validate against
+        out: List[Finding] = []
+        for use in self.index.config_uses:
+            if use.key in defined or use.key in CONFIG_API_ATTRS:
+                continue
+            what = {
+                "attr": "attribute read",
+                "override": "override key",
+                "env": "env knob",
+            }[use.via]
+            out.append(self._site_finding(
+                use, self.name,
+                f"config {what} `{use.key}` is not defined in "
+                f"{self.index.config_defs_path} _DEFS — "
+                + ("reads raise AttributeError" if use.via == "attr" else
+                   "Config(overrides) raises ValueError" if use.via == "override"
+                   else "the env var is silently ignored"),
+            ))
+        return out
+
+
 def static_lock_graph(paths, root=None):
     """The lock-order checker's accumulated graph for the given paths:
     ({node: {kind, where}}, {(src, dst): (path, line)}). Used by tests to

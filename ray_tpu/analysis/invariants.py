@@ -1,0 +1,493 @@
+"""Happens-before protocol tracing + offline invariant checking.
+
+The dynamic cross-check of the static protocol model in
+:mod:`ray_tpu.analysis.protocol` — the same relationship the runtime
+lock-order sanitizer has to the static lock graph. A
+:class:`ProtocolTracer` installed via :func:`install` records control-
+plane events (frame sends/recvs from hook points in ``cluster/rpc.py``
+plus application-level *apply* events from the GCS/daemon/client) to a
+JSONL trace, each stamped with a Lamport clock. :func:`check_trace`
+replays a trace offline and verifies the protocol invariants the
+retry/replay machinery of the reconnecting control plane must preserve:
+
+- **exactly-once**: a ``task_done`` report mutates GCS state at most once
+  per (task, execution) — watchdog resends and chaos-duplicated frames
+  must be absorbed by the dedupe paths;
+- **capacity conservation**: per node, outstanding dispatched demand
+  (tasks + staged PG bundles) never exceeds the node total and never goes
+  negative — releases match allocations (cf. Narayanan et al.,
+  "Heterogeneity-Aware Cluster Scheduling Policies": every guarantee
+  presumes the capacity ledger never drifts);
+- **PG 2PC legality**: per (node, pg, bundle), commit transitions only
+  out of a prepared state; returns/aborts are idempotent;
+- **actor ordering**: per (caller, actor, hosting worker) executed
+  sequence numbers are strictly increasing;
+- **borrow conservation**: borrow releases never exceed registrations
+  per (object, worker); optionally, terminal outstanding count is zero;
+- **object lifecycle**: an object location is only ever recorded after a
+  store put on that node, and never re-surfaces after a free without an
+  intervening re-creation (created -> sealed/put -> located -> freed).
+
+Activation mirrors ``ray_tpu.chaos``: a single module-global hook
+(``rpc.TRACE``) checked with ``is None`` on the hot path — zero overhead
+when no tracer is installed — plus ``RAY_TPU_TRACE_FILE`` env activation
+so spawned subprocesses can join the same trace file (append-mode, one
+JSON line per event; in-process daemons/GCS/driver are what the
+invariants need, so tests normally trace only the test process).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+ENV_TRACE = "RAY_TPU_TRACE_FILE"
+
+#: rpc methods whose apply semantics the invariant checker models; the
+#: static protocol dump must know every one of them (see
+#: test_dump_protocol_roundtrips_method_table) so the two halves cannot
+#: drift apart silently.
+METHOD_TABLE: Dict[str, str] = {
+    "submit_task": "exactly-once (GCS running-table dedupe)",
+    "task_done": "exactly-once + capacity release + object location",
+    "register_node": "capacity ledger reset semantics",
+    "node_sync": "object location resync",
+    "add_object_location": "object lifecycle (located)",
+    "free_objects": "object lifecycle (freed)",
+    "prepare_bundle": "PG 2PC prepare",
+    "commit_bundle": "PG 2PC commit",
+    "create_placement_group": "PG capacity stage",
+    "remove_placement_group": "PG capacity release",
+    "actor_call": "per-caller actor seq monotonicity",
+    "register_borrows": "borrow conservation (register)",
+    "borrow_released": "borrow conservation (release)",
+    "kill_actor": "actor lifetime-hold release",
+    "actor_died": "actor lifetime-hold release",
+    "stream_item": "object lifecycle (located)",
+}
+
+_EPS = 1e-4
+
+
+def _jsonable(v: Any) -> Any:
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return str(v)
+
+
+class ProtocolTracer:
+    """Append-only JSONL event recorder with a Lamport clock.
+
+    One instance per process; every event costs one lock + one buffered
+    line write, paid ONLY while installed (the rpc layer guards each hook
+    behind ``if TRACE is not None``). The clock is process-global and
+    merged from incoming frame clocks (``_lc``), so multi-process traces
+    interleave causally; in the single-process test topology (GCS +
+    daemons in-process, workers as subprocesses whose frames are clocked
+    at the receiving daemon) the clock is a total order consistent with
+    program order under the GCS/daemon locks.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._clock = 0
+        self._pid = os.getpid()
+        self._f = open(path, "a", encoding="utf-8")
+        self.closed = False
+
+    def _emit(self, rec: Dict[str, Any]) -> int:
+        with self._lock:
+            self._clock += 1
+            rec["c"] = self._clock
+            rec["pid"] = self._pid
+            if not self.closed:
+                self._f.write(json.dumps(rec, default=_jsonable) + "\n")
+                self._f.flush()
+            return self._clock
+
+    # ------------------------------------------------------- rpc hooks
+
+    def on_send(self, src: str, dst: str, method: Optional[str]) -> int:
+        """Client-side frame send; the returned clock rides the frame as
+        ``_lc`` so the receiving process can merge it."""
+        return self._emit({"t": "send", "src": src, "dst": dst, "m": method})
+
+    def on_recv(self, src: str, dst: str, method: Optional[str],
+                remote_clock: Optional[int]) -> None:
+        with self._lock:
+            if remote_clock is not None and remote_clock > self._clock:
+                self._clock = remote_clock
+        self._emit({"t": "recv", "src": src, "dst": dst, "m": method})
+
+    def on_push(self, src: str, dst: str, channel: Optional[str]) -> None:
+        self._emit({"t": "push", "src": src, "dst": dst, "ch": channel})
+
+    # ---------------------------------------------------- apply events
+
+    def apply(self, kind: str, **fields: Any) -> None:
+        """Application-level state mutation (GCS/daemon/client hooks)."""
+        rec: Dict[str, Any] = {"t": "apply", "k": kind}
+        rec.update(fields)
+        self._emit(rec)
+
+    def close(self) -> None:
+        with self._lock:
+            self.closed = True
+            try:
+                self._f.close()
+            except OSError:
+                pass
+
+
+# ------------------------------------------------------------ activation
+
+
+def install(path: str) -> ProtocolTracer:
+    """Make a fresh tracer writing to ``path`` the process-wide trace
+    plane (``cluster/rpc.py`` hooks + every apply-event site)."""
+    from ray_tpu.cluster import rpc as _rpc
+
+    tracer = ProtocolTracer(path)
+    _rpc.TRACE = tracer
+    return tracer
+
+
+def uninstall() -> None:
+    from ray_tpu.cluster import rpc as _rpc
+
+    tracer, _rpc.TRACE = _rpc.TRACE, None
+    if tracer is not None:
+        tracer.close()
+
+
+def active() -> Optional[ProtocolTracer]:
+    from ray_tpu.cluster import rpc as _rpc
+
+    return _rpc.TRACE
+
+
+def install_from_env() -> Optional[ProtocolTracer]:
+    path = os.environ.get(ENV_TRACE)
+    if not path:
+        return None
+    return install(path)
+
+
+# -------------------------------------------------------------- checking
+
+
+@dataclasses.dataclass
+class Violation:
+    kind: str
+    message: str
+    clock: int
+
+    def format(self) -> str:
+        return f"[{self.kind}] c={self.clock}: {self.message}"
+
+
+def read_trace(path: str) -> List[Dict[str, Any]]:
+    """Parse a JSONL trace, totally ordered by (clock, pid, file order).
+    Tolerates a torn final line (a killed process mid-write)."""
+    events: List[Tuple[int, int, int, Dict]] = []
+    with open(path, "r", encoding="utf-8") as f:
+        for i, line in enumerate(f):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn tail from a killed writer
+            events.append((int(ev.get("c", 0)), int(ev.get("pid", 0)), i, ev))
+    events.sort(key=lambda t: (t[0], t[1], t[2]))
+    return [ev for _c, _p, _i, ev in events]
+
+
+class InvariantChecker:
+    """Replays a trace's apply events against the protocol invariants."""
+
+    def __init__(self):
+        self.violations: List[Violation] = []
+        # capacity model
+        self.node_total: Dict[str, Dict[str, float]] = {}
+        self.node_alive: Dict[str, bool] = {}
+        # node -> {ledger_key: resources}; keys are task ids, actor-hold
+        # ids, or ("pg", pg_id, bundle_index) tuples
+        self.ledger: Dict[str, Dict[Any, Dict[str, float]]] = {}
+        self.wiped: set = set()  # ledger keys erased by node death/reset
+        # exactly-once: task -> node of the outstanding dispatch
+        self.outstanding: Dict[str, str] = {}
+        # PG 2PC daemon-side state per (node, pg, bundle)
+        self.pg2pc: Dict[Tuple, str] = {}
+        # actor ordering: (owner, actor, worker) -> last seq
+        self.actor_seq: Dict[Tuple, int] = {}
+        # borrows: outstanding (oid, worker) registrations
+        self.borrows: set = set()
+        # object lifecycle: oid -> {"nodes": set, "freed": clock|None,
+        #                           "put_after_free": bool}
+        self.objects: Dict[str, Dict[str, Any]] = {}
+
+    # ------------------------------------------------------------ helpers
+
+    def _bad(self, kind: str, clock: int, msg: str) -> None:
+        self.violations.append(Violation(kind, msg, clock))
+
+    @staticmethod
+    def _res(v: Any) -> Dict[str, float]:
+        return {str(k): float(x) for k, x in (v or {}).items()}
+
+    def _alloc(self, clock: int, node: str, key: Any,
+               res: Dict[str, float]) -> None:
+        led = self.ledger.setdefault(node, {})
+        if key in led:
+            self._bad("capacity", clock,
+                      f"allocation key {key!r} on {node} allocated twice "
+                      "without release")
+            return
+        led[key] = res
+        self.wiped.discard(key)
+        total = self.node_total.get(node)
+        if total is None:
+            return
+        sums: Dict[str, float] = {}
+        for r in led.values():
+            for name, amt in r.items():
+                sums[name] = sums.get(name, 0.0) + amt
+        for name, amt in sums.items():
+            if amt > total.get(name, 0.0) + _EPS:
+                self._bad("capacity", clock,
+                          f"node {node} oversubscribed on {name}: "
+                          f"{amt:.3f} outstanding > {total.get(name, 0.0):.3f} "
+                          f"total (alloc {key!r})")
+
+    def _release(self, clock: int, key: Any, node: Optional[str]) -> None:
+        # a LIVE ledger entry always wins over a stale wiped marker: an
+        # actor-hold wiped by one node's death can be re-created on a new
+        # node (restart) and must release normally there
+        for n, led in self.ledger.items():
+            if key in led:
+                if node is not None and n != node:
+                    self._bad("capacity", clock,
+                              f"release of {key!r} on {node} but the "
+                              f"allocation lives on {n}")
+                del led[key]
+                self.wiped.discard(key)
+                return
+        if key in self.wiped:
+            self.wiped.discard(key)
+            return  # released after its node died: the wipe already covered it
+        self._bad("capacity", clock,
+                  f"release of {key!r} with no outstanding allocation "
+                  "(double-release or conservation drift)")
+
+    def _wipe_node(self, node: str) -> None:
+        for key in list(self.ledger.get(node, {})):
+            self.wiped.add(key)
+        self.ledger[node] = {}
+        for task, n in list(self.outstanding.items()):
+            if n == node:
+                del self.outstanding[task]
+
+    # -------------------------------------------------------------- apply
+
+    def run(self, events: List[Dict[str, Any]],
+            strict_terminal: bool = False) -> List[Violation]:
+        for ev in events:
+            if ev.get("t") != "apply":
+                continue
+            handler = getattr(self, "_on_" + ev.get("k", ""), None)
+            if handler is not None:
+                handler(ev)
+        if strict_terminal:
+            clock = events[-1].get("c", 0) if events else 0
+            for oid_worker in sorted(self.borrows):
+                self._bad("borrow", clock,
+                          f"borrow {oid_worker!r} never released "
+                          "(terminal count nonzero)")
+        return self.violations
+
+    def _on_node(self, ev: Dict) -> None:
+        node = ev["node"]
+        if ev.get("revived") or node not in self.node_total:
+            # fresh row or revival after death: availability reset, so the
+            # ledger resets with it
+            self._wipe_node(node)
+            self.node_total[node] = self._res(ev.get("resources"))
+        # live connection bounce (revived=False on a known node): the GCS
+        # keeps the row as-is, so the ledger keeps its entries
+        self.node_alive[node] = True
+
+    def _on_node_dead(self, ev: Dict) -> None:
+        node = ev["node"]
+        self.node_alive[node] = False
+        self._wipe_node(node)
+
+    def _on_dispatch(self, ev: Dict) -> None:
+        task, node = ev["task"], ev["node"]
+        if task in self.outstanding:
+            self._bad("exactly-once", ev["c"],
+                      f"task {task} dispatched to {node} while an earlier "
+                      f"dispatch to {self.outstanding[task]} is still "
+                      "outstanding")
+        self.outstanding[task] = node
+        if not self.node_alive.get(node, False):
+            self._bad("capacity", ev["c"],
+                      f"task {task} dispatched to dead/unknown node {node}")
+        # PG-riding tasks debit their bundle, not the node: ledger entry is
+        # empty but still keyed so the release pairs up
+        self._alloc(ev["c"], node, task,
+                    {} if ev.get("pg") else self._res(ev.get("res")))
+
+    def _on_task_done(self, ev: Dict) -> None:
+        task = ev["task"]
+        if task not in self.outstanding:
+            self._bad("exactly-once", ev["c"],
+                      f"task_done for {task} applied with no outstanding "
+                      "dispatch — a resend/duplicate escaped the dedupe")
+            return
+        del self.outstanding[task]
+
+    def _on_task_done_dup(self, ev: Dict) -> None:
+        pass  # informational: a dedup that worked
+
+    def _on_retag(self, ev: Dict) -> None:
+        old, new = ev["old"], ev["new"]
+        for led in self.ledger.values():
+            if old in led:
+                led[new] = led.pop(old)
+                # the hold key may carry a stale wiped marker from a
+                # PREVIOUS incarnation's node death (actor restarts reuse
+                # actor-hold-<id>); the fresh entry supersedes it
+                self.wiped.discard(new)
+                return
+        if old in self.wiped:
+            self.wiped.discard(old)
+            self.wiped.add(new)
+
+    def _on_release(self, ev: Dict) -> None:
+        self._release(ev["c"], ev["key"], ev.get("node"))
+
+    def _on_pg_stage(self, ev: Dict) -> None:
+        pg = ev["pg"]
+        for led in self.ledger.values():
+            for key in led:
+                if isinstance(key, (tuple, list)) and len(key) == 3 \
+                        and key[0] == "pg" and key[1] == pg:
+                    self._bad("pg-2pc", ev["c"],
+                              f"pg {pg} staged while bundle allocation "
+                              f"{key!r} is still outstanding")
+        for i, (node, bundle) in enumerate(
+            zip(ev.get("nodes") or (), ev.get("bundles") or ())
+        ):
+            self._alloc(ev["c"], node, ("pg", pg, i), self._res(bundle))
+
+    def _on_pg_reapply(self, ev: Dict) -> None:
+        # snapshot-restored bundle re-applied as its node re-registered;
+        # ordinal-keyed (bundle indices are not in the snapshot tuple)
+        node, pg = ev["node"], ev["pg"]
+        n = sum(
+            1 for led in self.ledger.values() for key in led
+            if isinstance(key, (tuple, list)) and key[0] == "pg"
+            and key[1] == pg
+        )
+        self._alloc(ev["c"], node, ("pg", pg, f"reapply-{n}"),
+                    self._res(ev.get("res")))
+
+    def _on_pg_release(self, ev: Dict) -> None:
+        pg = ev["pg"]
+        for led in self.ledger.values():
+            for key in list(led):
+                if isinstance(key, (tuple, list)) and len(key) == 3 \
+                        and key[0] == "pg" and key[1] == pg:
+                    del led[key]
+        for key in list(self.wiped):
+            if isinstance(key, (tuple, list)) and key and key[0] == "pg" \
+                    and key[1] == pg:
+                self.wiped.discard(key)
+
+    def _on_pg_created(self, ev: Dict) -> None:
+        pass  # allocations persist for the PG's lifetime — nothing to move
+
+    def _on_pg_prepare(self, ev: Dict) -> None:
+        if ev.get("ok"):
+            self.pg2pc[(ev["node"], ev["pg"], ev["bundle"])] = "PREPARED"
+
+    def _on_pg_commit(self, ev: Dict) -> None:
+        key = (ev["node"], ev["pg"], ev["bundle"])
+        if not ev.get("ok"):
+            return  # refused commit (no surviving prepare): legal outcome
+        if not ev.get("transition", True):
+            return  # idempotent re-commit of an already-committed bundle
+        if self.pg2pc.get(key) != "PREPARED":
+            self._bad("pg-2pc", ev["c"],
+                      f"bundle {key!r} committed from state "
+                      f"{self.pg2pc.get(key, 'IDLE')!r} (commit without "
+                      "prepare / commit after abort)")
+        self.pg2pc[key] = "COMMITTED"
+
+    def _on_pg_return(self, ev: Dict) -> None:
+        self.pg2pc.pop((ev["node"], ev["pg"], ev["bundle"]), None)
+
+    def _on_actor_exec(self, ev: Dict) -> None:
+        seq = ev.get("seq")
+        if seq is None:
+            return
+        key = (ev.get("owner"), ev["actor"], ev.get("worker"))
+        last = self.actor_seq.get(key)
+        if last is not None and int(seq) <= last:
+            self._bad("actor-seq", ev["c"],
+                      f"actor {ev['actor']} executed seq {seq} after seq "
+                      f"{last} for the same caller on the same worker "
+                      "(submission-order execution broken)")
+        else:
+            self.actor_seq[key] = int(seq)
+
+    def _on_borrow_reg(self, ev: Dict) -> None:
+        self.borrows.add((ev["oid"], ev.get("worker")))
+
+    def _on_borrow_rel(self, ev: Dict) -> None:
+        key = (ev["oid"], ev.get("worker"))
+        if key not in self.borrows:
+            self._bad("borrow", ev["c"],
+                      f"borrow release for {key!r} without a registration "
+                      "(releases exceed registers)")
+            return
+        self.borrows.discard(key)
+
+    def _on_obj_put(self, ev: Dict) -> None:
+        o = self.objects.setdefault(
+            ev["oid"], {"nodes": set(), "freed": None}
+        )
+        o["nodes"].add(ev.get("node"))
+        if o["freed"] is not None:
+            o["freed"] = None  # legal re-creation (retry / reconstruction)
+
+    def _on_obj_loc(self, ev: Dict) -> None:
+        oid, node = ev["oid"], ev.get("node")
+        o = self.objects.get(oid)
+        if o is None or node not in o["nodes"]:
+            self._bad("object-lifecycle", ev["c"],
+                      f"location of {oid[:12]} on {node} recorded without "
+                      "a store put on that node")
+            return
+        if o["freed"] is not None:
+            self._bad("object-lifecycle", ev["c"],
+                      f"location of {oid[:12]} on {node} re-surfaced after "
+                      "free with no re-creation (ghost directory entry)")
+
+    def _on_obj_free(self, ev: Dict) -> None:
+        o = self.objects.get(ev["oid"])
+        if o is not None:
+            o["freed"] = ev["c"]
+
+
+def check_trace(path: str, strict_terminal: bool = False) -> List[Violation]:
+    """Replay the JSONL trace at ``path`` and return every invariant
+    violation (empty list = the run was protocol-clean)."""
+    return InvariantChecker().run(read_trace(path), strict_terminal)

@@ -731,6 +731,11 @@ class ClusterClient:
         inflight: set = set()
         flight_cv = threading.Condition()
         last_node: List[Optional[str]] = [None]
+        # ordering guard state: highest seq handed to call_async, and
+        # highest seq KNOWN to have executed (daemon answered with a real
+        # execution outcome, not a routing bounce)
+        max_sent: List[int] = [-1]
+        max_execed: List[int] = [-1]
 
         def _done(seq):
             with flight_cv:
@@ -742,6 +747,9 @@ class ClusterClient:
             if got is None:
                 return
             seq, (meta, refs) = got
+            # ride the wire so the daemon's invariant tracer can witness
+            # per-caller execution order (analysis/invariants.py)
+            meta["seq"] = seq
 
             def fail(err, refs=refs, meta=meta):
                 for r in refs:
@@ -750,6 +758,27 @@ class ClusterClient:
                 self._release_task_deps(meta["task_id"])
 
             try:
+                if seq <= max_sent[0]:
+                    # REPLAY of a bounced call: later-seq calls may already
+                    # be in flight (pipelining). Drain them first so their
+                    # outcomes are known, then refuse to replay behind a
+                    # later call that actually executed — sending seq k
+                    # after seq k+1 ran on the new incarnation would break
+                    # submission-order execution (the invariant sanitizer's
+                    # actor-seq check). At-most-once semantics make failing
+                    # the bounced call the correct outcome.
+                    with flight_cv:
+                        deadline = time.time() + 60
+                        while inflight and time.time() < deadline:
+                            flight_cv.wait(timeout=1.0)
+                    if max_execed[0] > seq:
+                        fail(ActorDiedError(
+                            f"actor call (seq {seq}) bounced during a "
+                            f"restart after a later call (seq "
+                            f"{max_execed[0]}) already executed on the new "
+                            "incarnation; replaying would reorder execution"
+                        ))
+                        continue
                 info = self._actor_location(actor_id, wait=True, timeout=60)
                 if info is None or info.get("state") == "DEAD":
                     fail(ActorDiedError(f"actor {actor_id} is dead"))
@@ -763,6 +792,7 @@ class ClusterClient:
                 daemon = self._daemon(info["node_id"], info["addr"], info["port"])
                 with flight_cv:
                     inflight.add(seq)
+                    max_sent[0] = max(max_sent[0], seq)
                 fut = daemon.call_async("actor_call", meta)
             except (ConnectionLost, OSError, Exception) as e:  # noqa: BLE001
                 _done(seq)
@@ -770,10 +800,10 @@ class ClusterClient:
                 continue
 
             def on_done(f, seq=seq, meta=meta, refs=refs, actor_id=actor_id):
-                _done(seq)
                 try:
                     p = f.result()
                 except (ConnectionLost, OSError) as e:
+                    _done(seq)
                     # daemon died with the call possibly mid-execution:
                     # at-most-once — fail, never replay (reference: actor
                     # calls in flight at death get ActorDiedError)
@@ -784,12 +814,24 @@ class ClusterClient:
                     self._release_task_deps(meta["task_id"])
                     return
                 except Exception as e:  # noqa: BLE001
+                    _done(seq)
                     err = TaskError(f"actor call failed: {e!r}")
                     for r in refs:
                         self.store.put(r, err, is_exception=True)
                     self._finalize_actor_call(refs, err)
                     self._release_task_deps(meta["task_id"])
                     return
+                if p.get("status") != "ACTOR_UNREACHABLE":
+                    # a real execution outcome (not a routing bounce):
+                    # feeds the replay-ordering guard above. Recorded
+                    # BEFORE _done releases the in-flight slot — the
+                    # dispatcher's replay drain wakes on _done, so a
+                    # later-recorded max_execed could let a bounced call
+                    # replay behind this one (the exact inversion the
+                    # guard exists to stop).
+                    with flight_cv:
+                        max_execed[0] = max(max_execed[0], seq)
+                _done(seq)
                 if p.get("status") == "ACTOR_UNREACHABLE" and \
                         self._maybe_replay_actor_call(actor_id, seq, meta, refs):
                     return

@@ -23,6 +23,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from ray_tpu.core.config import Config
+from ray_tpu.cluster import rpc as rpc_mod
 from ray_tpu.cluster.rpc import RpcClient, RpcServer
 from ray_tpu.sched.policy import make_policy_from_config
 from ray_tpu.sched.resources import NodeResourceState, ResourceSpace
@@ -228,6 +229,10 @@ class GcsServer:
         for pid, b, nid in self._pending_bundle_reapply:
             if nid == node_id:
                 self.state.allocate(idx, self.space.vector(b))
+                if rpc_mod.TRACE is not None:
+                    rpc_mod.TRACE.apply(
+                        "pg_reapply", pg=pid, node=nid, res=dict(b)
+                    )
             else:
                 remaining.append((pid, b, nid))
         self._pending_bundle_reapply = remaining
@@ -247,7 +252,24 @@ class GcsServer:
 
         with self._lock:
             node_id = p["node_id"]
-            rejoin = node_id in self.nodes
+            prev = self.nodes.get(node_id)
+            rejoin = prev is not None
+            # Same node id, ALIVE row, but a different daemon process
+            # (fresh `instance` stamp): the old incarnation's workers,
+            # running tasks, and store are gone even though no heartbeat
+            # timeout fired yet. Run the death sweep FIRST so its tasks
+            # fail over and its capacity holds are wiped — otherwise the
+            # revive below would erase debits the running table still
+            # carries (capacity-ledger drift the invariant sanitizer
+            # flags). A matching instance is a mere connection bounce.
+            if (
+                prev is not None and prev.get("alive")
+                and p.get("instance") is not None
+                and prev.get("instance") != p.get("instance")
+            ):
+                self._mark_node_dead(
+                    node_id, "superseded by a new daemon instance"
+                )
             self.nodes[node_id] = {
                 "node_id": node_id,
                 "addr": p["addr"],
@@ -258,6 +280,7 @@ class GcsServer:
                 "last_beat": time.time(),
                 "labels": p.get("labels", {}),
                 "shm_name": p.get("shm_name"),
+                "instance": p.get("instance"),
             }
             # recorded only after the entry commits (a malformed payload
             # must not leave an event for a node that never joined); rejoin
@@ -266,11 +289,25 @@ class GcsServer:
             record_event("NODE_ADDED", f"node {node_id} registered",
                          source="gcs", node_id=node_id, rejoin=rejoin)
             conn.meta["node_id"] = node_id
-            if self.state.node_index(node_id) is None:
+            idx = self.state.node_index(node_id)
+            revived = True
+            if idx is None:
                 self.state.add_node(node_id, p["resources"], p.get("labels"))
-            else:
+            elif not self.state.alive[idx]:
                 # re-registration after a death: revive the scheduler row
                 self.state.revive_node(node_id, p["resources"])
+            else:
+                # live re-registration (the daemon's GCS connection
+                # bounced, same process): the row is already correct and
+                # running tasks still hold capacity — resetting
+                # availability here would let the scheduler double-book
+                # the node until their releases clamp out
+                revived = False
+            if rpc_mod.TRACE is not None:
+                rpc_mod.TRACE.apply(
+                    "node", node=node_id, resources=dict(p["resources"]),
+                    rejoin=rejoin, revived=revived,
+                )
             # restored-from-snapshot PG bundles land on this node's row
             self._reapply_bundles_for_node(node_id)
             self._pg_retry_needed = True
@@ -298,6 +335,10 @@ class GcsServer:
             for oid in p.get("object_ids", []):
                 self.directory[oid].add(node_id)
                 self._on_object_added(oid)
+                if rpc_mod.TRACE is not None:
+                    rpc_mod.TRACE.apply(
+                        "obj_loc", oid=oid, node=node_id, resync=True
+                    )
         self._kick()
         return {"ok": True}
 
@@ -503,24 +544,68 @@ class GcsServer:
         """From a node daemon: task finished. p: {task_id, node_id, status,
         results: [(oid, size)], inline: {oid: bytes}, error?, actor_id?}"""
         with self._lock:
+            # Dedupe decision FIRST: the retry plane may resend an
+            # already-applied report after an unanswered ack window, and
+            # chaos can duplicate the frame outright. Everything below
+            # that is not idempotent-by-construction gates on
+            # first_report — the directory re-add in particular used to
+            # run unconditionally, so a resend landing after the owner
+            # freed the results re-inserted ghost locations (caught by
+            # the object-lifecycle invariant; see
+            # test_resent_task_done_does_not_resurrect_freed_objects).
+            seen_key = (p.get("task_id"), p.get("node_id"), p.get("status"),
+                        p.get("start"), p.get("end"))
+            first_report = seen_key not in self._taskdone_seen
+            if first_report:
+                self._taskdone_seen[seen_key] = True
+                while len(self._taskdone_seen) > 8192:
+                    self._taskdone_seen.popitem(last=False)
             info = self.running.pop(p["task_id"], None)
             if info is not None:
                 self._track_exit(info.get("meta", {}))
+            if rpc_mod.TRACE is not None:
+                if info is not None:
+                    rpc_mod.TRACE.apply(
+                        "task_done", task=p["task_id"],
+                        node=p.get("node_id"), status=p.get("status"),
+                    )
+                else:
+                    rpc_mod.TRACE.apply("task_done_dup", task=p["task_id"])
             if info is not None:
                 if p.get("actor_creation") and p.get("status") == "FINISHED":
                     # alive actors hold their allocation for their lifetime
                     # (released by kill_actor / node death); a bundle-riding
                     # actor likewise holds its bundle debit
                     self.running[f"actor-hold-{p['actor_id']}"] = info
+                    if rpc_mod.TRACE is not None:
+                        rpc_mod.TRACE.apply(
+                            "retag", old=p["task_id"],
+                            new=f"actor-hold-{p['actor_id']}",
+                        )
                 else:
                     idx = self.state.node_index(info["node_id"])
                     if idx is not None:
                         self.state.release(idx, info["demand"])
                     self._credit_pg_locked(info.get("meta"))
                     self._pg_retry_needed = True
-            for oid, size in p.get("results", []):
-                self.directory[oid].add(p["node_id"])
-                self._on_object_added(oid)
+                    if rpc_mod.TRACE is not None:
+                        rpc_mod.TRACE.apply(
+                            "release", key=p["task_id"],
+                            node=info["node_id"],
+                        )
+            if first_report:
+                for oid, size in p.get("results", []):
+                    self.directory[oid].add(p["node_id"])
+                    self._on_object_added(oid)
+                    if rpc_mod.TRACE is not None:
+                        rpc_mod.TRACE.apply(
+                            "obj_loc", oid=oid, node=p["node_id"]
+                        )
+                self.task_events.append(
+                    {k: p.get(k) for k in ("task_id", "node_id", "status",
+                                           "name", "start", "end",
+                                           "actor_id")}
+                )
             cross_borrow_pushes = []
             task_owner_id = None
             if info is not None:
@@ -528,8 +613,18 @@ class GcsServer:
                 if task_owner_id is None:
                     d = self.drivers.get(info.get("owner_conn"))
                     task_owner_id = d.get("driver_id") if d else None
-            for b in p.get("borrows") or ():
-                self.borrows[(b["id"], p.get("borrow_worker"))] = {
+            # first_report-gated like the directory adds: a resend landing
+            # after the borrower already released would re-insert a ghost
+            # borrow record that nothing ever releases (the owner then
+            # defers the free until node death)
+            for b in (p.get("borrows") or ()) if first_report else ():
+                bkey = (b["id"], p.get("borrow_worker"))
+                if bkey not in self.borrows and rpc_mod.TRACE is not None:
+                    rpc_mod.TRACE.apply(
+                        "borrow_reg", oid=b["id"],
+                        worker=p.get("borrow_worker"),
+                    )
+                self.borrows[bkey] = {
                     "node_id": p["node_id"], "owner": b["owner"],
                 }
                 if b["owner"] != task_owner_id:
@@ -541,17 +636,6 @@ class GcsServer:
                             "object_id": b["id"],
                             "worker_id": p.get("borrow_worker"),
                         }))
-            seen_key = (p.get("task_id"), p.get("node_id"), p.get("status"),
-                        p.get("start"), p.get("end"))
-            if seen_key not in self._taskdone_seen:
-                self._taskdone_seen[seen_key] = True
-                while len(self._taskdone_seen) > 8192:
-                    self._taskdone_seen.popitem(last=False)
-                self.task_events.append(
-                    {k: p.get(k) for k in ("task_id", "node_id", "status",
-                                           "name", "start", "end",
-                                           "actor_id")}
-                )
             owner_conn = info["owner_conn"] if info else p.get("owner_conn")
             owner_id = (info.get("meta") or {}).get("owner") if info else None
             alive_actor = None
@@ -571,6 +655,12 @@ class GcsServer:
                                 if idx is not None:
                                     self.state.release(idx, hold["demand"])
                                 self._credit_pg_locked(hold.get("meta"))
+                                if rpc_mod.TRACE is not None:
+                                    rpc_mod.TRACE.apply(
+                                        "release",
+                                        key=f"actor-hold-{p['actor_id']}",
+                                        node=hold["node_id"],
+                                    )
                             kill_on_node = p["node_id"]
                         else:
                             a["state"] = "ALIVE"
@@ -644,6 +734,10 @@ class GcsServer:
         with self._lock:
             self.directory[p["object_id"]].add(p["node_id"])
             ready = self._on_object_added(p["object_id"])
+            if rpc_mod.TRACE is not None:
+                rpc_mod.TRACE.apply(
+                    "obj_loc", oid=p["object_id"], node=p["node_id"]
+                )
         if ready:
             self._kick()
         return {"ok": True}
@@ -708,7 +802,14 @@ class GcsServer:
         pushes = []
         with self._lock:
             for b in p.get("borrows", []):
-                self.borrows[(b["id"], p["worker_id"])] = {
+                bkey = (b["id"], p["worker_id"])
+                if bkey not in self.borrows and rpc_mod.TRACE is not None:
+                    # transition-only: a resent registration overwrites
+                    # idempotently and must not look like a second borrow
+                    rpc_mod.TRACE.apply(
+                        "borrow_reg", oid=b["id"], worker=p["worker_id"]
+                    )
+                self.borrows[bkey] = {
                     "node_id": p["node_id"], "owner": b["owner"],
                 }
                 t_conn = self._conn_for_driver_id(b["owner"])
@@ -724,7 +825,14 @@ class GcsServer:
         """A borrower dropped its last reference (or its daemon is speaking
         for a dead worker): forget the record, tell the owner."""
         with self._lock:
-            self.borrows.pop((p["object_id"], p.get("worker_id")), None)
+            popped = self.borrows.pop(
+                (p["object_id"], p.get("worker_id")), None
+            )
+            if popped is not None and rpc_mod.TRACE is not None:
+                rpc_mod.TRACE.apply(
+                    "borrow_rel", oid=p["object_id"],
+                    worker=p.get("worker_id"),
+                )
             target = self._conn_for_driver_id(p.get("owner"))
         if target is not None:
             self._push_conn(target, "borrow_released", {
@@ -753,6 +861,10 @@ class GcsServer:
         with self._lock:
             self.directory[p["object_id"]].add(p["node_id"])
             ready = self._on_object_added(p["object_id"])
+            if rpc_mod.TRACE is not None:
+                rpc_mod.TRACE.apply(
+                    "obj_loc", oid=p["object_id"], node=p["node_id"]
+                )
             info = self.running.get(p["task_id"])
             owner = (
                 self._driver_conn(
@@ -802,6 +914,8 @@ class GcsServer:
             for oid in p["object_ids"]:
                 for nid in self.directory.pop(oid, set()):
                     homes[nid].append(oid)
+                if rpc_mod.TRACE is not None:
+                    rpc_mod.TRACE.apply("obj_free", oid=oid)
         for nid, oids in homes.items():
             self._push_to_node(nid, "free_objects", {"object_ids": oids})
         return {"ok": True}
@@ -865,6 +979,11 @@ class GcsServer:
             if idx is not None and self.state.alive[idx]:
                 self.state.release(idx, info["demand"])
             self._credit_pg_locked(info.get("meta"))
+            if rpc_mod.TRACE is not None:
+                rpc_mod.TRACE.apply(
+                    "release", key=f"actor-hold-{aid}",
+                    node=info["node_id"],
+                )
         meta = a.get("creation_meta")
         max_restarts = a.get("max_restarts", 0)
         budget_left = max_restarts == -1 or a.get("restarts", 0) < max_restarts
@@ -899,6 +1018,11 @@ class GcsServer:
                 if idx is not None:
                     self.state.release(idx, info["demand"])
                 self._credit_pg_locked(info.get("meta"))
+                if rpc_mod.TRACE is not None:
+                    rpc_mod.TRACE.apply(
+                        "release", key=f"actor-hold-{p['actor_id']}",
+                        node=info["node_id"],
+                    )
         if nid:
             self._push_to_node(nid, "kill_actor", {"actor_id": p["actor_id"]})
         self.server.broadcast("actor_update", {"actor_id": p["actor_id"], "state": "DEAD"})
@@ -1116,6 +1240,11 @@ class GcsServer:
             "strategy": strategy, "nodes": node_ids,
             "epoch": prev.get("epoch", 0),
         }
+        if rpc_mod.TRACE is not None:
+            rpc_mod.TRACE.apply(
+                "pg_stage", pg=pg_id, nodes=list(node_ids),
+                bundles=[dict(b) for b in bundles],
+            )
         return node_ids
 
     def _finalize_pg(self, pg_id, bundles, node_ids) -> bool:
@@ -1145,6 +1274,8 @@ class GcsServer:
                 )
                 pg["state"] = "CREATED"
                 pg["epoch"] = pg.get("epoch", 0) + 1
+                if rpc_mod.TRACE is not None:
+                    rpc_mod.TRACE.apply("pg_created", pg=pg_id)
                 # per-bundle capacity accounting: tasks riding a bundle debit
                 # it (reference: placement_group_resource_manager.cc minting
                 # CPU_group_<pgid> resources that bundle tasks consume)
@@ -1155,6 +1286,8 @@ class GcsServer:
                 return True
             # prepare or commit failed: return the held resources, park
             self._release_pg_allocations_locked(pg)
+            if rpc_mod.TRACE is not None:
+                rpc_mod.TRACE.apply("pg_release", pg=pg_id)
             pg["state"] = "PENDING"
             pg["nodes"] = None
             self._pg_retry_needed = True
@@ -1204,6 +1337,8 @@ class GcsServer:
                 "CREATED", "PREPARING"
             ):
                 self._release_pg_allocations_locked(pg)
+                if rpc_mod.TRACE is not None:
+                    rpc_mod.TRACE.apply("pg_release", pg=p["pg_id"])
                 self._pg_retry_needed = True
                 nodes = list(pg["nodes"])
             else:
@@ -1417,6 +1552,12 @@ class GcsServer:
                     "owner_conn": t["owner_conn"],
                     "meta": t,
                 }
+                if rpc_mod.TRACE is not None:
+                    rpc_mod.TRACE.apply(
+                        "dispatch", task=t["task_id"], node=node_id,
+                        res=self.space.unvector(demand),
+                        pg=bool(t.get("pg_debit")),
+                    )
                 if t.get("actor_creation"):
                     aid = t.get("actor_id")
                     if aid in self.actors:
@@ -1675,6 +1816,8 @@ class GcsServer:
                          node_id=node_id, cause=cause)
             n["alive"] = False
             self.state.remove_node(node_id)
+            if rpc_mod.TRACE is not None:
+                rpc_mod.TRACE.apply("node_dead", node=node_id, cause=cause)
             lost_tasks = [
                 (tid, info) for tid, info in self.running.items()
                 if info["node_id"] == node_id
@@ -1785,6 +1928,10 @@ class GcsServer:
                     and pg.get("state") in ("CREATED", "PREPARING")
                 ):
                     self._release_pg_allocations_locked(pg, skip_node=node_id)
+                    if rpc_mod.TRACE is not None:
+                        rpc_mod.TRACE.apply(
+                            "pg_release", pg=pg["pg_id"], skip=node_id
+                        )
                     for b_idx, nid in enumerate(pg["nodes"]):
                         if nid != node_id:
                             pg_returns.append((nid, pg["pg_id"], b_idx))
@@ -1797,6 +1944,11 @@ class GcsServer:
             for (oid, wid), rec in list(self.borrows.items()):
                 if rec["node_id"] == node_id:
                     del self.borrows[(oid, wid)]
+                    if rpc_mod.TRACE is not None:
+                        rpc_mod.TRACE.apply(
+                            "borrow_rel", oid=oid, worker=wid,
+                            node_death=True,
+                        )
                     target = self._conn_for_driver_id(rec.get("owner"))
                     if target is not None:
                         borrow_releases.append((target, oid, wid))

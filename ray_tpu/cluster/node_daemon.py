@@ -26,6 +26,7 @@ from typing import Any, Dict, List, Optional
 
 from ray_tpu.core.config import Config
 from ray_tpu.core.task_spec import new_id
+from ray_tpu.cluster import rpc as rpc_mod
 from ray_tpu.cluster.rpc import (
     ConnectionLost,
     RetryingRpcClient,
@@ -191,6 +192,11 @@ class NodeDaemon:
     ):
         self.config = config or Config()
         self.node_id = node_id or new_id("node")
+        # process-unique incarnation stamp: lets the GCS distinguish a
+        # reconnect of THIS daemon (keep the resource row as-is) from a
+        # fresh daemon re-using the node id (old incarnation's tasks and
+        # capacity holds must be swept first)
+        self.instance = new_id("inst")
         self.resources = dict(resources)
         self.host = host
         spill_root = self.config.object_spilling_dir or os.path.join(
@@ -275,12 +281,7 @@ class NodeDaemon:
         self.gcs.subscribe(
             "free_objects", lambda p: self.store.delete(p["object_ids"])
         )
-        self.gcs.subscribe(
-            "return_bundle",
-            lambda p: self._bundles.pop(
-                f"{p['pg_id']}:{p['bundle_index']}", None
-            ),
-        )
+        self.gcs.subscribe("return_bundle", self._on_return_bundle)
         self.gcs.subscribe("nodes", self._on_nodes_update)
         self.gcs.connect()
         self._beat_thread = threading.Thread(
@@ -304,7 +305,7 @@ class NodeDaemon:
         reply = gcs.call("register_node", {
             "node_id": self.node_id, "addr": self.host, "port": self.port,
             "resources": self.resources, "labels": self._labels,
-            "shm_name": self.shm_name,
+            "shm_name": self.shm_name, "instance": self.instance,
         }, timeout=timeout)
         assert reply["ok"]
         if not first:
@@ -437,7 +438,7 @@ class NodeDaemon:
             try:
                 self.gcs.call_async("borrow_released", {
                     "object_id": oid, "owner": owner,
-                    "worker_id": worker_id, "node_id": self.node_id,
+                    "worker_id": worker_id,
                 })
             except Exception:  # noqa: BLE001
                 pass
@@ -497,9 +498,13 @@ class NodeDaemon:
         (result_shm: [(oid, size)]) or as packed payload bytes (fallback)."""
         for oid, payload in p.get("result_payloads", {}).items():
             self.store.put(oid, payload)
+            if rpc_mod.TRACE is not None:
+                rpc_mod.TRACE.apply("obj_put", oid=oid, node=self.node_id)
         if p.get("result_shm") and hasattr(self.store, "note"):
             for oid, _size in p["result_shm"]:
                 self.store.note(oid)
+                if rpc_mod.TRACE is not None:
+                    rpc_mod.TRACE.apply("obj_put", oid=oid, node=self.node_id)
         worker_id = conn.meta.get("worker_id")
         if p.get("borrows") and worker_id:
             held = self._worker_borrows.setdefault(worker_id, {})
@@ -542,6 +547,10 @@ class NodeDaemon:
             self.store.put(p["object_id"], payload)
         elif hasattr(self.store, "note"):
             self.store.note(p["object_id"])
+        if rpc_mod.TRACE is not None:
+            rpc_mod.TRACE.apply(
+                "obj_put", oid=p["object_id"], node=self.node_id
+            )
         inline = None
         if (
             payload is not None
@@ -630,7 +639,7 @@ class NodeDaemon:
         try:
             self.gcs.call_async("borrow_released", {
                 "object_id": p["object_id"], "owner": p.get("owner"),
-                "worker_id": worker_id, "node_id": self.node_id,
+                "worker_id": worker_id,
             })
         except Exception:  # noqa: BLE001
             pass
@@ -650,6 +659,10 @@ class NodeDaemon:
         publish its location."""
         if hasattr(self.store, "note"):
             self.store.note(p["object_id"])
+        if rpc_mod.TRACE is not None:
+            rpc_mod.TRACE.apply(
+                "obj_put", oid=p["object_id"], node=self.node_id
+            )
         try:
             # async: rpc handlers run on the event loop; the location
             # publish must not block it on a GCS round trip
@@ -662,6 +675,10 @@ class NodeDaemon:
 
     def rpc_put_object(self, p, conn):
         self.store.put(p["object_id"], p["payload"])
+        if rpc_mod.TRACE is not None:
+            rpc_mod.TRACE.apply(
+                "obj_put", oid=p["object_id"], node=self.node_id
+            )
         try:
             self.gcs.call_async("add_object_location", {
                 "object_id": p["object_id"], "node_id": self.node_id,
@@ -816,6 +833,16 @@ class NodeDaemon:
                 )
             return
         self._actor_tasks[t["task_id"]] = t
+        if rpc_mod.TRACE is not None:
+            # the call reached a hosted worker: it WILL execute (serially,
+            # in arrival order) — the unit the per-caller seq-monotonicity
+            # invariant is defined over. Bounced calls (no worker) never
+            # get here.
+            rpc_mod.TRACE.apply(
+                "actor_exec", actor=aid, seq=t.get("seq"),
+                owner=t.get("owner"), task=t["task_id"],
+                worker=w.worker_id, node=self.node_id,
+            )
         self.server.call_soon(
             lambda c=w.conn, task=t: asyncio.ensure_future(c.push("run_task", task))
         )
@@ -935,6 +962,11 @@ class NodeDaemon:
                     if self._pull_from_peer(
                         peer, entry["node_id"], oid, deadline
                     ):
+                        if rpc_mod.TRACE is not None:
+                            rpc_mod.TRACE.apply(
+                                "obj_put", oid=oid, node=self.node_id,
+                                pulled=True,
+                            )
                         try:
                             self.gcs.call("add_object_location", {
                                 "object_id": oid, "node_id": self.node_id,
@@ -1081,7 +1113,13 @@ class NodeDaemon:
     # reservation mapping, the analog of minting CPU_group_<pgid>) ---
 
     def rpc_prepare_bundle(self, p, conn):
-        if self._stopped:
+        ok = not self._stopped
+        if rpc_mod.TRACE is not None:
+            rpc_mod.TRACE.apply(
+                "pg_prepare", pg=p["pg_id"], bundle=p["bundle_index"],
+                node=self.node_id, ok=ok,
+            )
+        if not ok:
             return {"ok": False, "error": "daemon stopping"}
         key = f"{p['pg_id']}:{p['bundle_index']}"
         self._bundles[key] = {**p, "state": "PREPARED"}
@@ -1090,13 +1128,33 @@ class NodeDaemon:
     def rpc_commit_bundle(self, p, conn):
         key = f"{p['pg_id']}:{p['bundle_index']}"
         ent = self._bundles.get(key)
-        if ent is None or self._stopped:
+        ok = not (ent is None or self._stopped)
+        if rpc_mod.TRACE is not None:
+            # transition=False marks an idempotent re-commit (a chaos-
+            # duplicated frame): legal, and the invariant checker must not
+            # read it as a double-commit
+            rpc_mod.TRACE.apply(
+                "pg_commit", pg=p["pg_id"], bundle=p["bundle_index"],
+                node=self.node_id, ok=ok,
+                transition=ok and ent.get("state") != "COMMITTED",
+            )
+        if not ok:
             # commit without a surviving prepare (daemon restarted between
             # phases): refuse so the GCS returns the bundle and re-packs
             return {"ok": False, "error": "no prepared bundle"}
         ent["state"] = "COMMITTED"
         return {"ok": True}
 
+
+    def _on_return_bundle(self, p):
+        """GCS aborts/releases a 2PC bundle reservation (failed prepare
+        round, PG removal, gang reset after a member node death)."""
+        popped = self._bundles.pop(f"{p['pg_id']}:{p['bundle_index']}", None)
+        if popped is not None and rpc_mod.TRACE is not None:
+            rpc_mod.TRACE.apply(
+                "pg_return", pg=p["pg_id"], bundle=p["bundle_index"],
+                node=self.node_id,
+            )
 
     def _on_nodes_update(self, snapshot):
         self._nodes_snapshot = snapshot

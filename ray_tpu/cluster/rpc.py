@@ -45,6 +45,16 @@ MAX_FRAME = 1 << 31
 # every hook below costs one global load + identity check when disabled.
 CHAOS = None
 
+# Active protocol tracer, or None. Set ONLY by
+# ray_tpu.analysis.invariants.install/uninstall — same zero-overhead
+# pattern as CHAOS: one global load + identity check per frame when
+# disabled. When installed, every client send and server recv is recorded
+# with a Lamport clock (requests carry it as a top-level "_lc" frame key,
+# beside "id"/"method", so payloads are untouched), and the GCS/daemon
+# apply hooks record state mutations to the same trace for the offline
+# invariant checker.
+TRACE = None
+
 
 class RpcError(Exception):
     pass
@@ -141,6 +151,8 @@ class ServerConn:
     async def push(self, channel: str, data: Any):
         if self.closed:
             return
+        if TRACE is not None:
+            TRACE.on_push(self.server_name, self.peer_label(), channel)
         twice = False
         if CHAOS is not None:
             deliver, twice = await self._chaos_send(channel)
@@ -226,6 +238,11 @@ class RpcServer:
         try:
             while True:
                 msg = await read_frame(reader)
+                if TRACE is not None:
+                    TRACE.on_recv(
+                        conn.peer_label(), self.name, msg.get("method"),
+                        msg.pop("_lc", None),
+                    )
                 if CHAOS is not None:
                     if not await self._chaos_recv(conn, msg):
                         continue
@@ -500,7 +517,10 @@ class RpcClient:
             mid = self._next_id
         fut: Future = Future()
         self._pending[mid] = fut
-        data = frame_bytes({"id": mid, "method": method, "params": params})
+        msg = {"id": mid, "method": method, "params": params}
+        if TRACE is not None:
+            msg["_lc"] = TRACE.on_send(self.name, self.peer, method)
+        data = frame_bytes(msg)
         if CHAOS is not None:
             act = CHAOS.on_client_send(self.name, self.peer, method)
             if act is not None:
@@ -543,7 +563,10 @@ class RpcClient:
         """Fire-and-forget (no response expected)."""
         if self._closed:
             raise ConnectionLost("client closed")
-        data = frame_bytes({"method": method, "params": params})
+        msg = {"method": method, "params": params}
+        if TRACE is not None:
+            msg["_lc"] = TRACE.on_send(self.name, self.peer, method)
+        data = frame_bytes(msg)
         if CHAOS is not None:
             act = CHAOS.on_client_send(self.name, self.peer, method)
             if act is not None:
@@ -1020,3 +1043,13 @@ if os.environ.get("RAY_TPU_CHAOS_SPEC"):  # pragma: no cover - env-driven
         _chaos.install_from_env()
 
     _install_chaos_from_env()
+
+# Same one-time activation for the protocol tracer: subprocesses started
+# with RAY_TPU_TRACE_FILE append to the shared JSONL trace.
+if os.environ.get("RAY_TPU_TRACE_FILE"):  # pragma: no cover - env-driven
+    def _install_trace_from_env():
+        from ray_tpu.analysis import invariants as _inv
+
+        _inv.install_from_env()
+
+    _install_trace_from_env()

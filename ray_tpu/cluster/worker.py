@@ -430,8 +430,7 @@ def main():  # pragma: no cover - runs as a subprocess
     import ray_tpu
 
     ray_tpu.init(ignore_reinit_error=True)
-    client.call("worker_ready", {"worker_id": worker_id, "pid": os.getpid()},
-                timeout=30.0)
+    client.call("worker_ready", {"worker_id": worker_id}, timeout=30.0)
     # Threaded-actor pool (reference: max_concurrency>1): methods of an actor
     # created with max_concurrency>1 may overlap/block on each other.
     from concurrent.futures import ThreadPoolExecutor

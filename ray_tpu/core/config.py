@@ -85,6 +85,9 @@ _DEFS: Dict[str, tuple] = {
     # keep a queryable timeline without unbounded RSS
     "task_events_recent_cap": (int, 10_000),
     "task_events_spill": (bool, True),
+    # anonymized local usage recording (util/usage.py); opt out with
+    # RAY_TPU_usage_stats_enabled=0 (reference: RAY_USAGE_STATS_ENABLED)
+    "usage_stats_enabled": (bool, True),
 }
 
 

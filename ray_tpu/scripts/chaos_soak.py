@@ -11,8 +11,13 @@ seeded kill rules consulted once per loop iteration (the step() hook), and
 frame-level faults (driver->GCS resets, daemon->GCS drops) ride the RPC
 hook points. The workload mix is driven by the same seed, so two runs with
 one seed replay the same soak — compare their sched.trace_text() to verify.
-Last recorded run (2026-08-02, 2-core host, seed 7): 120s, 907 tasks, 336
-actor calls, 85 PGs, 56 node kills, 0 task errors.
+Every run is also protocol-traced and invariant-checked post-hoc
+(analysis/invariants.py): the process exits 1 on any exactly-once /
+capacity-conservation / 2PC / ordering violation.
+Last recorded run (2026-08-03, 2-core host, seed 7, invariant tracing on,
+concurrent test load): 120s, 142 tasks, 56 actor calls, 14 PGs, 6 node
+kills, 0 task errors, 0 invariant violations. (Pre-tracing idle-host run
+2026-08-02: 907 tasks / 56 kills / 0 errors.)
 """
 import argparse
 import random
@@ -28,7 +33,32 @@ ap = argparse.ArgumentParser(description=__doc__)
 ap.add_argument("--seed", type=int, default=7,
                 help="fault-schedule + workload seed (same seed = same soak)")
 ap.add_argument("--duration", type=float, default=600.0, help="seconds")
+ap.add_argument("--trace", default=None, metavar="FILE",
+                help="protocol-trace JSONL path (default: a fresh temp "
+                     "file); the run is invariant-checked post-hoc and "
+                     "exits 1 on violations")
 args = ap.parse_args()
+
+# Every soak run is invariant-checked post-hoc (analysis/invariants.py):
+# "survived" means exactly-once task_done, conserved capacity, legal PG
+# 2PC, ordered actor execs — not just "didn't crash".
+from ray_tpu.analysis import invariants
+
+if args.trace:
+    trace_path = args.trace
+    # the tracer appends; a leftover file from a previous run would feed
+    # stale events into this run's invariant check
+    open(trace_path, "w").close()
+else:
+    import tempfile
+
+    _fd, trace_path = tempfile.mkstemp(
+        prefix="chaos_soak_trace_", suffix=".jsonl"
+    )
+    import os as _os
+
+    _os.close(_fd)
+invariants.install(trace_path)
 
 rng = random.Random(args.seed)  # workload mix (tasks vs actors vs PGs)
 sched = chaos.install(chaos.FaultSchedule(seed=args.seed, rules=[
@@ -144,4 +174,12 @@ print("actor totals:", totals, flush=True)
 print("fault trace (%d faults):" % len(sched.trace()), flush=True)
 print(sched.trace_text(), flush=True)
 ray_tpu.shutdown(); cluster.shutdown(); chaos.uninstall()
+invariants.uninstall()
+violations = invariants.check_trace(trace_path)
+print("protocol trace: %s (%d violations)" % (trace_path, len(violations)),
+      flush=True)
+for v in violations:
+    print("  " + v.format(), flush=True)
 print("SOAK DONE; task errors:", stats["errors"], flush=True)
+if violations:
+    raise SystemExit(1)

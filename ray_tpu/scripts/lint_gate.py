@@ -1,0 +1,140 @@
+"""One-command CI / pre-commit gate for the analysis toolchain.
+
+    python -m ray_tpu.scripts.lint_gate [--tier1] [--artifact-dir DIR]
+
+Runs, in order, failing fast with a distinct exit code per contract:
+
+1. the FULL linter (every registered checker) over ``ray_tpu/`` with the
+   committed ratchet baseline — exit-code contract: 0 clean, 1 new
+   findings, 2 usage/parse errors;
+2. the baseline-ratchet check: the committed baseline must be EMPTY
+   (violations get fixed or pragma'd, never grandfathered — entries may
+   only ever be removed);
+3. a ``--dump-protocol`` extraction (the protocol model must stay
+   parseable) cross-checked against the invariant checker's METHOD_TABLE
+   — every rpc method the dynamic half models must exist statically;
+4. optionally (``--tier1``) the tier-1 pytest run with ``--durations=25``,
+   teeing output to an artifact file so CI keeps a per-test timing
+   budget trail (see BENCH_NOTES.md "Tier-1 wall-cap hygiene").
+
+Artifacts land in ``--artifact-dir`` (default ``artifacts/``):
+``lint.json`` (machine-readable findings), ``protocol.json`` (the dumped
+model), ``tier1_durations.txt`` (when --tier1 ran).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+BASELINE = os.path.join(REPO, ".ray-lint-baseline.json")
+
+TIER1_CMD = (
+    "set -o pipefail; timeout -k 10 870 env JAX_PLATFORMS=cpu "
+    "python -m pytest tests/ -q -m 'not slow' --durations=25 "
+    "--continue-on-collection-errors -p no:cacheprovider"
+)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--tier1", action="store_true",
+                    help="also run the tier-1 suite with --durations=25 "
+                         "and save the output as an artifact")
+    ap.add_argument("--artifact-dir", default=os.path.join(REPO, "artifacts"))
+    args = ap.parse_args(argv)
+    os.makedirs(args.artifact_dir, exist_ok=True)
+
+    # (1) full linter, all checkers, ratchet baseline, JSON out
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.analysis", "ray_tpu",
+         "--baseline", BASELINE, "--format", "json"],
+        cwd=REPO, capture_output=True, text=True,
+    )
+    lint_path = os.path.join(args.artifact_dir, "lint.json")
+    with open(lint_path, "w") as f:
+        f.write(proc.stdout)
+    if proc.returncode == 2:
+        print("lint_gate: analysis CLI usage/parse error", file=sys.stderr)
+        sys.stderr.write(proc.stderr)
+        return 2
+    try:
+        lint = json.loads(proc.stdout)
+    except json.JSONDecodeError:
+        print("lint_gate: analysis CLI emitted unparseable JSON",
+              file=sys.stderr)
+        return 2
+    if proc.returncode == 1 or lint["new"]:
+        print(f"lint_gate: {len(lint['new'])} NEW finding(s) — fix or "
+              "pragma them (the baseline only ratchets down):",
+              file=sys.stderr)
+        for fnd in lint["new"]:
+            print(f"  {fnd['path']}:{fnd['line']}: [{fnd['check']}] "
+                  f"{fnd['message']}", file=sys.stderr)
+        return 1
+    print(f"lint: clean ({lint['files_scanned']} files, "
+          f"{len(lint['checks'])} checkers, {lint['suppressed']} "
+          "pragma-suppressed)")
+
+    # (2) baseline ratchet: committed baseline stays EMPTY
+    if os.path.exists(BASELINE):
+        with open(BASELINE) as f:
+            entries = json.load(f).get("findings", {})
+        if entries:
+            print(f"lint_gate: committed baseline carries {len(entries)} "
+                  "entries — it must stay empty (fix, don't grandfather)",
+                  file=sys.stderr)
+            return 1
+    print("baseline: empty (ratchet holds)")
+
+    # (3) protocol model extraction + dynamic/static cross-check
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.analysis", "ray_tpu",
+         "--dump-protocol"],
+        cwd=REPO, capture_output=True, text=True,
+    )
+    if proc.returncode != 0:
+        print("lint_gate: --dump-protocol failed", file=sys.stderr)
+        sys.stderr.write(proc.stderr)
+        return 2
+    with open(os.path.join(args.artifact_dir, "protocol.json"), "w") as f:
+        f.write(proc.stdout)
+    model = json.loads(proc.stdout)
+    from ray_tpu.analysis.invariants import METHOD_TABLE
+
+    missing = sorted(set(METHOD_TABLE) - set(model["handlers"]))
+    if missing:
+        print("lint_gate: invariant METHOD_TABLE names rpc methods with "
+              f"no static handler: {missing}", file=sys.stderr)
+        return 1
+    print(f"protocol: {len(model['handlers'])} methods, "
+          f"{len(model['calls'])} call sites; invariant method table "
+          "round-trips")
+
+    # (4) tier-1 with per-test durations as a CI artifact
+    if args.tier1:
+        art = os.path.join(args.artifact_dir, "tier1_durations.txt")
+        with open(art, "w") as f:
+            proc = subprocess.Popen(
+                ["bash", "-c", TIER1_CMD], cwd=REPO,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            )
+            for line in proc.stdout:
+                sys.stdout.write(line)
+                f.write(line)
+            rc = proc.wait()
+        print(f"tier-1 durations artifact: {art}")
+        if rc != 0:
+            print(f"lint_gate: tier-1 run failed (rc={rc})", file=sys.stderr)
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,12 +26,18 @@ _lock = threading.Lock()
 # JSONL sink: RAY_TPU_EVENT_LOG=<path> (reference: the event framework's
 # per-session event_*.log files), or configure_sink() programmatically
 _sink_path: Optional[str] = os.environ.get("RAY_TPU_EVENT_LOG") or None
+# sink paths that already produced a write-failure warning: one warning
+# per path, not one per event (a bad path would otherwise either spam
+# stderr at event rate or — as before — swallow every failure silently)
+_sink_warned: set = set()
 
 
 def configure_sink(path: Optional[str]) -> None:
     """Also append events as JSON lines to `path` (None disables)."""
     global _sink_path
     _sink_path = path
+    if path is not None:
+        _sink_warned.discard(path)  # a reconfigured sink may warn again
 
 
 def record_event(
@@ -60,8 +66,21 @@ def record_event(
         try:
             with open(path, "a") as f:
                 f.write(json.dumps(ev, default=str) + "\n")
-        except OSError:
-            pass
+        except OSError as e:
+            # warn ONCE per sink path — telemetry loss must be visible,
+            # but a misconfigured path must not print per event (and must
+            # never break the recording caller)
+            with _lock:
+                warn = path not in _sink_warned
+                _sink_warned.add(path)
+            if warn:
+                import sys
+
+                print(
+                    f"[ray_tpu] event sink {path!r} unwritable ({e}); "
+                    "events keep recording to the in-memory ring",
+                    file=sys.stderr,
+                )
     return ev
 
 

@@ -16,8 +16,17 @@ from typing import Any, Dict
 
 
 def usage_stats_enabled() -> bool:
-    v = os.environ.get("RAY_TPU_usage_stats_enabled", "1").lower()
-    return v not in ("0", "false", "no", "off")
+    # The knob now lives in core/config.py _DEFS (config-key-unknown
+    # flagged the old free-floating env read — _system_config overrides
+    # silently did nothing). A LIVE environ read stays first so flipping
+    # RAY_TPU_usage_stats_enabled mid-process still opts out (GLOBAL_CONFIG
+    # snapshots the environment at import).
+    env = os.environ.get("RAY_TPU_usage_stats_enabled")
+    if env is not None:
+        return env.lower() not in ("0", "false", "no", "off")
+    from ray_tpu.core.config import GLOBAL_CONFIG
+
+    return bool(GLOBAL_CONFIG.usage_stats_enabled)
 
 
 def _usage_path() -> str:

@@ -40,6 +40,35 @@ def local_ray():
 
 
 @pytest.fixture
+def invariant_sanitizer(tmp_path):
+    """Opt-in protocol-invariant recorder (ray_tpu.analysis.invariants).
+
+    While installed, the RPC layer records frame sends/recvs and the
+    GCS/daemon/client record apply events (dispatch, task_done, capacity
+    release, PG 2PC phases, actor execs, borrows, object lifecycle) to a
+    Lamport-clocked JSONL trace. At teardown the offline checker replays
+    the trace and the test FAILS on any invariant violation — every
+    chaos survival run is checked for exactly-once / conservation /
+    ordering, not just "didn't crash". The dynamic cross-check of the
+    static protocol model (``--dump-protocol``), mirroring how
+    ``lock_sanitizer`` cross-checks the static lock graph.
+    """
+    from ray_tpu.analysis import invariants
+
+    trace_path = str(tmp_path / "protocol_trace.jsonl")
+    tracer = invariants.install(trace_path)
+    try:
+        yield tracer
+    finally:
+        invariants.uninstall()
+        violations = invariants.check_trace(trace_path)
+        assert not violations, (
+            "protocol invariant violation(s):\n"
+            + "\n".join(v.format() for v in violations)
+        )
+
+
+@pytest.fixture
 def lock_sanitizer():
     """Opt-in runtime lock-order recorder (ray_tpu.analysis.sanitizer).
 

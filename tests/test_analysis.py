@@ -1441,3 +1441,592 @@ def test_find_cycles_is_shared_and_dedups():
     cyc = sorted(frozenset(c) for c in find_cycles(adj))
     assert cyc == sorted([frozenset({"a", "b"}), frozenset({"b", "c", "d"})])
     assert find_cycles({"a": ["b"], "b": ["c"]}) == []
+
+
+# ============================================================ protocol checkers
+#
+# The four whole-program protocol checks (analysis/protocol.py feeding
+# checkers.py): each exercised firing / clean / pragma-suppressed, plus
+# the self-gating that keeps single-file scans quiet.
+
+PROTO_SERVER = """
+class GcsServer:
+    def rpc_submit_task(self, p, conn):
+        return {"ok": p["task_id"], "extra": p.get("owner")}
+
+    def rpc_heartbeat(self, p, conn):
+        node = p["node_id"]
+        return {"ok": True}
+"""
+
+
+def _lint_two(tmp_path, server_src, client_src, select):
+    (tmp_path / "server.py").write_text(textwrap.dedent(server_src))
+    (tmp_path / "client_mod.py").write_text(textwrap.dedent(client_src))
+    res = analyze_paths([str(tmp_path)], root=str(tmp_path), select=select)
+    assert not res.errors, res.errors
+    return res
+
+
+def test_rpc_method_unknown_fires_on_typo(tmp_path):
+    res = _lint_two(tmp_path, PROTO_SERVER, """
+        def go(c):
+            c.call("submit_tsak", {"task_id": "t"}, timeout=5)
+    """, ["rpc-method-unknown"])
+    assert checks(res) == ["rpc-method-unknown"]
+    assert "submit_tsak" in res.findings[0].message
+
+
+def test_rpc_method_known_is_clean_and_pragma_suppresses(tmp_path):
+    res = _lint_two(tmp_path, PROTO_SERVER, """
+        def go(c):
+            c.call("submit_task", {"task_id": "t"}, timeout=5)
+            c.notify("heartbeet", {"node_id": "n"})  # ray-lint: disable=rpc-method-unknown
+    """, ["rpc-method-unknown"])
+    assert res.findings == [] and res.suppressed == 1
+
+
+def test_rpc_method_check_gates_on_handler_surface(tmp_path):
+    """No rpc_* handlers in scope: a lone client file must not fire."""
+    res = lint(tmp_path, """
+        def go(c):
+            c.call("anything_at_all", {}, timeout=5)
+    """, select=["rpc-method-unknown"])
+    assert res.findings == []
+
+
+def test_payload_missing_required_key_fires(tmp_path):
+    res = _lint_two(tmp_path, PROTO_SERVER, """
+        def go(c):
+            c.call("submit_task", {"owner": "d"}, timeout=5)
+    """, ["rpc-payload-key-mismatch"])
+    assert checks(res) == ["rpc-payload-key-mismatch"]
+    assert "task_id" in res.findings[0].message
+
+
+def test_payload_dead_key_fires_and_get_is_optional(tmp_path):
+    res = _lint_two(tmp_path, PROTO_SERVER, """
+        def go(c):
+            c.call("submit_task", {"task_id": "t", "ghost": 1}, timeout=5)
+            c.call("submit_task", {"task_id": "t", "owner": "d"}, timeout=5)
+    """, ["rpc-payload-key-mismatch"])
+    assert len(res.findings) == 1
+    assert "ghost" in res.findings[0].message
+
+
+def test_payload_open_handler_suppresses_unknown_keys(tmp_path):
+    res = _lint_two(tmp_path, """
+        class S:
+            def rpc_forward(self, p, conn):
+                stash(dict(p))          # payload escapes whole
+                return p["task_id"]
+    """, """
+        def go(c):
+            c.call("forward", {"task_id": "t", "anything": 1}, timeout=5)
+    """, ["rpc-payload-key-mismatch"])
+    assert res.findings == []
+
+
+def test_payload_open_dict_literal_skips_missing_check(tmp_path):
+    """A **-expanded payload dict may supply required keys invisibly."""
+    res = _lint_two(tmp_path, PROTO_SERVER, """
+        def go(c, extra):
+            c.call("submit_task", {"owner": "d", **extra}, timeout=5)
+    """, ["rpc-payload-key-mismatch"])
+    assert res.findings == []
+
+
+def test_payload_mismatch_pragma(tmp_path):
+    res = _lint_two(tmp_path, PROTO_SERVER, """
+        def go(c):
+            c.call("submit_task", {"owner": "d"}, timeout=5)  # ray-lint: disable=rpc-payload-key-mismatch
+    """, ["rpc-payload-key-mismatch"])
+    assert res.findings == [] and res.suppressed == 1
+
+
+def test_push_topic_unknown_fires_and_wrapper_arg_position(tmp_path):
+    res = _lint_two(tmp_path, """
+        class S:
+            def fan(self, conn, nid):
+                self.server.broadcast("nodes", {})
+                self._push_to_node(nid, "exec_tasksss", [])
+    """, """
+        def attach(c):
+            c.subscribe("nodes", print)
+    """, ["push-topic-unknown"])
+    assert checks(res) == ["push-topic-unknown"]
+    assert "exec_tasksss" in res.findings[0].message
+
+
+def test_push_topic_gates_on_subscriber_surface(tmp_path):
+    res = lint(tmp_path, """
+        def fan(server):
+            server.broadcast("lonely_topic", {})
+    """, select=["push-topic-unknown"])
+    assert res.findings == []  # no .subscribe() anywhere in scope
+
+
+def test_push_topic_pragma(tmp_path):
+    res = _lint_two(tmp_path, """
+        def fan(server):
+            server.broadcast("lonely", {})  # ray-lint: disable=push-topic-unknown
+    """, """
+        def attach(c):
+            c.subscribe("other", print)
+    """, ["push-topic-unknown"])
+    assert res.findings == [] and res.suppressed == 1
+
+
+CONFIG_DEFS = """
+_DEFS = {
+    "rpc_call_timeout_s": (float, 30.0),
+    "gcs_port": (int, 0),
+}
+"""
+
+
+def _lint_config(tmp_path, user_src):
+    core = tmp_path / "core"
+    core.mkdir()
+    (core / "config.py").write_text(CONFIG_DEFS)
+    (tmp_path / "user.py").write_text(textwrap.dedent(user_src))
+    res = analyze_paths([str(tmp_path)], root=str(tmp_path),
+                        select=["config-key-unknown"])
+    assert not res.errors, res.errors
+    return res
+
+
+def test_config_unknown_attr_read_fires(tmp_path):
+    res = _lint_config(tmp_path, """
+        from core.config import GLOBAL_CONFIG
+        def f(config=None):
+            cfg = config or Config()
+            a = GLOBAL_CONFIG.rpc_call_timeout_s   # defined: clean
+            b = GLOBAL_CONFIG.rpc_call_timeout_sec # drifted: fires
+            c = cfg.gcs_prt                        # drifted: fires
+    """)
+    assert [f.check for f in res.findings] == ["config-key-unknown"] * 2
+    msgs = " ".join(f.message for f in res.findings)
+    assert "rpc_call_timeout_sec" in msgs and "gcs_prt" in msgs
+
+
+def test_config_override_dict_and_env_literal_fire(tmp_path):
+    res = _lint_config(tmp_path, """
+        import os
+        def f():
+            c = Config({"gcs_port": 1, "gcs_prot": 2})
+            e = os.environ.get("RAY_TPU_rpc_call_timeout")
+            ok = os.environ.get("RAY_TPU_WORKER_ID")  # infra var: exempt
+    """)
+    found = sorted(f.message.split("`")[1] for f in res.findings)
+    assert found == ["gcs_prot", "rpc_call_timeout"]
+
+
+def test_config_structural_inference_not_containment(tmp_path):
+    """Regression: `c = Cluster(config=Config(...))` builds a Cluster —
+    attribute reads on it must NOT be checked as knobs."""
+    res = _lint_config(tmp_path, """
+        def f():
+            c = Cluster(config=Config({"gcs_port": 1}))
+            c.add_node(num_cpus=2)
+            return c.address
+    """)
+    assert res.findings == []
+
+
+def test_config_check_gates_without_defs(tmp_path):
+    res = lint(tmp_path, """
+        def f():
+            return GLOBAL_CONFIG.surely_not_a_knob
+    """, select=["config-key-unknown"])
+    assert res.findings == []
+
+
+def test_config_self_attr_tracking(tmp_path):
+    res = _lint_config(tmp_path, """
+        class Server:
+            def __init__(self, config=None):
+                self.config = config or Config()
+            def go(self):
+                return self.config.rpc_call_timeout_z  # fires
+    """)
+    assert len(res.findings) == 1
+    assert "rpc_call_timeout_z" in res.findings[0].message
+
+
+# ===================================================== protocol dump roundtrip
+
+
+def test_dump_protocol_roundtrips_method_table():
+    """Every rpc method the DYNAMIC invariant checker models must exist
+    in the STATIC protocol model extracted from the real tree — the two
+    halves cannot silently drift apart."""
+    from ray_tpu.analysis.invariants import METHOD_TABLE
+    from ray_tpu.analysis.protocol import extract_protocol
+
+    idx = extract_protocol([os.path.join(REPO, "ray_tpu")])
+    missing = sorted(set(METHOD_TABLE) - idx.handler_methods())
+    assert not missing, f"METHOD_TABLE methods without handlers: {missing}"
+    # and the model is substantial: the whole control plane is in it
+    assert len(idx.handlers) >= 40
+    assert len(idx.calls) >= 50
+    assert idx.subscribed_topics() >= {"task_result", "exec_tasks", "nodes"}
+    assert "rpc_call_timeout_s" in idx.config_keys
+
+
+def test_dump_protocol_cli_emits_json():
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.analysis", "ray_tpu",
+         "--dump-protocol"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    model = json.loads(proc.stdout)
+    assert "submit_task" in model["handlers"]
+    h = model["handlers"]["submit_task"][0]
+    assert h["server"] == "gcs" and "task_id" in h["required"]
+
+
+# ========================================================= invariant checker
+
+
+def _check(events, **kw):
+    from ray_tpu.analysis.invariants import InvariantChecker
+
+    evs = [dict(e, t="apply", c=i + 1) for i, e in enumerate(events)]
+    return InvariantChecker().run(evs, **kw)
+
+
+NODE = {"k": "node", "node": "n1", "resources": {"CPU": 2.0}, "revived": True}
+
+
+def test_invariants_clean_task_flow():
+    assert _check([
+        NODE,
+        {"k": "dispatch", "task": "t1", "node": "n1", "res": {"CPU": 1.0}},
+        {"k": "task_done", "task": "t1", "node": "n1"},
+        {"k": "release", "key": "t1", "node": "n1"},
+    ]) == []
+
+
+def test_invariants_double_apply_fires():
+    vs = _check([
+        NODE,
+        {"k": "dispatch", "task": "t1", "node": "n1", "res": {"CPU": 1.0}},
+        {"k": "task_done", "task": "t1", "node": "n1"},
+        {"k": "task_done", "task": "t1", "node": "n1"},
+    ])
+    assert [v.kind for v in vs] == ["exactly-once"]
+
+
+def test_invariants_oversubscription_fires():
+    vs = _check([
+        NODE,
+        {"k": "dispatch", "task": "t1", "node": "n1", "res": {"CPU": 2.0}},
+        {"k": "dispatch", "task": "t2", "node": "n1", "res": {"CPU": 1.0}},
+    ])
+    assert any(v.kind == "capacity" and "oversubscribed" in v.message
+               for v in vs)
+
+
+def test_invariants_release_without_alloc_fires():
+    vs = _check([NODE, {"k": "release", "key": "ghost", "node": "n1"}])
+    assert [v.kind for v in vs] == ["capacity"]
+
+
+def test_invariants_node_death_wipes_ledger():
+    assert _check([
+        NODE,
+        {"k": "dispatch", "task": "t1", "node": "n1", "res": {"CPU": 1.0}},
+        {"k": "node_dead", "node": "n1"},
+        NODE,  # revived: fresh capacity
+        {"k": "dispatch", "task": "t1", "node": "n1", "res": {"CPU": 2.0}},
+        {"k": "task_done", "task": "t1", "node": "n1"},
+        {"k": "release", "key": "t1", "node": "n1"},
+    ]) == []
+
+
+def test_invariants_live_bounce_keeps_ledger():
+    """revived=False re-registration (connection bounce) must NOT reset
+    the ledger: the running task still holds its capacity."""
+    vs = _check([
+        NODE,
+        {"k": "dispatch", "task": "t1", "node": "n1", "res": {"CPU": 2.0}},
+        {"k": "node", "node": "n1", "resources": {"CPU": 2.0},
+         "rejoin": True, "revived": False},
+        {"k": "dispatch", "task": "t2", "node": "n1", "res": {"CPU": 1.0}},
+    ])
+    assert any(v.kind == "capacity" and "oversubscribed" in v.message
+               for v in vs)
+
+
+def test_invariants_restarted_hold_releases_cleanly():
+    """Regression (found on a live soak trace): an actor-hold wiped by
+    one node's death is re-created via retag on a NEW node after the
+    restart; its release there must pair with the LIVE entry, not be
+    swallowed by the stale wiped marker."""
+    assert _check([
+        NODE,
+        {"k": "node", "node": "n2", "resources": {"CPU": 2.0},
+         "revived": True},
+        {"k": "dispatch", "task": "ac1", "node": "n1", "res": {"CPU": 1.0}},
+        {"k": "task_done", "task": "ac1", "node": "n1"},
+        {"k": "retag", "old": "ac1", "new": "actor-hold-a"},
+        {"k": "node_dead", "node": "n1"},  # wipes actor-hold-a
+        {"k": "dispatch", "task": "ac1", "node": "n2", "res": {"CPU": 1.0}},
+        {"k": "task_done", "task": "ac1", "node": "n2"},
+        {"k": "retag", "old": "ac1", "new": "actor-hold-a"},
+        {"k": "release", "key": "actor-hold-a", "node": "n2"},
+        # capacity must actually be free again on n2:
+        {"k": "dispatch", "task": "t9", "node": "n2", "res": {"CPU": 2.0}},
+    ]) == []
+
+
+def test_invariants_pg_2pc_legality():
+    base = [NODE,
+            {"k": "pg_stage", "pg": "p1", "nodes": ["n1"],
+             "bundles": [{"CPU": 1.0}]},
+            {"k": "pg_prepare", "pg": "p1", "bundle": 0, "node": "n1",
+             "ok": True},
+            {"k": "pg_commit", "pg": "p1", "bundle": 0, "node": "n1",
+             "ok": True, "transition": True}]
+    assert _check(base) == []
+    # idempotent re-commit (chaos duplicate): transition=False, clean
+    assert _check(base + [
+        {"k": "pg_commit", "pg": "p1", "bundle": 0, "node": "n1",
+         "ok": True, "transition": False},
+    ]) == []
+    # commit without prepare: fires
+    vs = _check([
+        NODE,
+        {"k": "pg_commit", "pg": "p2", "bundle": 0, "node": "n1",
+         "ok": True, "transition": True},
+    ])
+    assert [v.kind for v in vs] == ["pg-2pc"]
+
+
+def test_invariants_pg_release_frees_capacity():
+    assert _check([
+        NODE,
+        {"k": "pg_stage", "pg": "p1", "nodes": ["n1"],
+         "bundles": [{"CPU": 2.0}]},
+        {"k": "pg_release", "pg": "p1"},
+        {"k": "dispatch", "task": "t1", "node": "n1", "res": {"CPU": 2.0}},
+    ]) == []
+
+
+def test_invariants_actor_seq_monotonic():
+    ex = lambda seq, worker="w1": {  # noqa: E731
+        "k": "actor_exec", "actor": "a1", "owner": "drv", "seq": seq,
+        "worker": worker, "task": f"at{seq}",
+    }
+    assert _check([ex(0), ex(1), ex(2)]) == []
+    vs = _check([ex(0), ex(2), ex(1)])
+    assert [v.kind for v in vs] == ["actor-seq"]
+    # same seqs on a NEW worker incarnation: legal
+    assert _check([ex(0), ex(1), ex(0, worker="w2"), ex(1, worker="w2")]) == []
+
+
+def test_invariants_borrow_conservation():
+    reg = {"k": "borrow_reg", "oid": "o1", "worker": "w1"}
+    rel = {"k": "borrow_rel", "oid": "o1", "worker": "w1"}
+    assert _check([reg, rel]) == []
+    assert [v.kind for v in _check([rel])] == ["borrow"]
+    assert [v.kind for v in _check([reg, rel, rel])] == ["borrow"]
+    # terminal leak only fires in strict mode
+    assert _check([reg]) == []
+    assert [v.kind for v in _check([reg], strict_terminal=True)] == ["borrow"]
+
+
+def test_invariants_object_lifecycle():
+    put = {"k": "obj_put", "oid": "o1", "node": "n1"}
+    loc = {"k": "obj_loc", "oid": "o1", "node": "n1"}
+    free = {"k": "obj_free", "oid": "o1"}
+    assert _check([put, loc, free]) == []
+    # ghost resurrection: located after free with no re-put
+    assert [v.kind for v in _check([put, loc, free, loc])] == [
+        "object-lifecycle"
+    ]
+    # re-creation (retry) then located: legal
+    assert _check([put, loc, free, put, loc]) == []
+    # located with no put anywhere: fires
+    assert [v.kind for v in _check([loc])] == ["object-lifecycle"]
+
+
+# ===================================================== tracer plumbing
+
+
+def test_trace_hook_disabled_by_default_and_zero_cost(tmp_path):
+    from ray_tpu.analysis import invariants
+    from ray_tpu.cluster import rpc
+
+    assert rpc.TRACE is None  # default state
+    tracer = invariants.install(str(tmp_path / "t.jsonl"))
+    assert invariants.active() is tracer
+    invariants.uninstall()
+    assert rpc.TRACE is None and tracer.closed
+
+
+def test_tracer_records_sends_recvs_and_applies_with_clock(tmp_path):
+    from ray_tpu.analysis import invariants
+    from ray_tpu.cluster.rpc import RpcClient, RpcServer
+
+    path = str(tmp_path / "t.jsonl")
+    tracer = invariants.install(path)
+    try:
+        server = RpcServer(lambda m, p, c: p, name="gcs")
+        port = server.start()
+        client = RpcClient("127.0.0.1", port, name="driver-t", peer="gcs")
+        assert client.call("echo", {"x": 1}, timeout=10) == {"x": 1}
+        tracer.apply("dispatch", task="t1", node="n1", res={})
+        client.close()
+        server.stop()
+    finally:
+        invariants.uninstall()
+    evs = invariants.read_trace(path)
+    kinds = [(e["t"], e.get("m") or e.get("k")) for e in evs]
+    assert ("send", "echo") in kinds and ("recv", "echo") in kinds
+    assert ("apply", "dispatch") in kinds
+    clocks = [e["c"] for e in evs]
+    assert clocks == sorted(clocks) and len(set(clocks)) == len(clocks)
+    # the recv merged the send's clock: recv strictly after send
+    send_c = next(e["c"] for e in evs if e["t"] == "send")
+    recv_c = next(e["c"] for e in evs if e["t"] == "recv")
+    assert recv_c > send_c
+
+
+def test_read_trace_tolerates_torn_tail(tmp_path):
+    p = tmp_path / "t.jsonl"
+    p.write_text('{"t": "apply", "k": "obj_free", "oid": "o", "c": 1, "pid": 1}\n'
+                 '{"t": "apply", "k": "obj_f')  # killed mid-write
+    from ray_tpu.analysis.invariants import read_trace
+
+    assert len(read_trace(str(p))) == 1
+
+
+def test_check_trace_cli_exit_codes(tmp_path):
+    from ray_tpu.analysis.invariants import ProtocolTracer
+
+    clean = tmp_path / "clean.jsonl"
+    t = ProtocolTracer(str(clean))
+    t.apply("obj_put", oid="o1", node="n1")
+    t.apply("obj_loc", oid="o1", node="n1")
+    t.close()
+    assert cli_main(["--check-trace", str(clean)]) == 0
+    bad = tmp_path / "bad.jsonl"
+    t = ProtocolTracer(str(bad))
+    t.apply("obj_loc", oid="o1", node="n1")  # located, never put
+    t.close()
+    assert cli_main(["--check-trace", str(bad)]) == 1
+    assert cli_main(["--check-trace", str(tmp_path / "missing.jsonl")]) == 2
+
+
+# ============================================ gcs protocol regressions (fixes)
+
+
+def _fresh_gcs():
+    from ray_tpu.core.config import Config as _Config
+    from ray_tpu.cluster.gcs import GcsServer
+    from ray_tpu.cluster.testing import park_scheduler_loop
+
+    g = GcsServer(config=_Config({"scheduler_round_interval_ms": 60_000.0}))
+    park_scheduler_loop(g)
+    return g
+
+
+def test_resent_task_done_does_not_resurrect_freed_objects():
+    """Regression for the ghost-location bug the object-lifecycle
+    invariant targets: the directory re-add ran BEFORE the task_done
+    dedupe, so a watchdog-resent report landing after the owner freed
+    the results re-inserted their locations."""
+    from ray_tpu.cluster.testing import FakeConn
+
+    g = _fresh_gcs()
+    try:
+        conn = FakeConn()
+        g.rpc_register_node(
+            {"node_id": "nA", "addr": "127.0.0.1", "port": 1,
+             "resources": {"CPU": 2}}, conn)
+        with g._lock:
+            g.running["t1"] = {
+                "node_id": "nA", "demand": g.space.vector({"CPU": 1}),
+                "owner_conn": conn.conn_id, "meta": {"task_id": "t1"},
+            }
+        report = {"task_id": "t1", "node_id": "nA", "status": "FINISHED",
+                  "results": [("obj-x", 10)], "start": 1.0, "end": 2.0}
+        g.rpc_task_done(dict(report), conn)
+        assert "nA" in g.directory.get("obj-x", set())
+        g.rpc_free_objects({"object_ids": ["obj-x"]}, conn)
+        assert "obj-x" not in g.directory
+        g.rpc_task_done(dict(report), conn)  # watchdog resend
+        assert "obj-x" not in g.directory, \
+            "resent task_done resurrected a freed object's location"
+    finally:
+        g.shutdown()
+
+
+def test_live_reregistration_keeps_capacity_debits():
+    """Regression: a daemon's GCS connection bounce re-registers the
+    node; reviving the row unconditionally reset availability while
+    running tasks still held capacity (ledger drift -> double-booking).
+    Same instance = keep the row; new instance = death sweep + revive."""
+    from ray_tpu.cluster.testing import FakeConn
+
+    g = _fresh_gcs()
+    try:
+        reg = {"node_id": "nA", "addr": "127.0.0.1", "port": 1,
+               "resources": {"CPU": 4}, "instance": "inst-1"}
+        g.rpc_register_node(dict(reg), FakeConn(1))
+        idx = g.state.node_index("nA")
+        assert g.state.allocate(idx, g.space.vector({"CPU": 3}))
+        with g._lock:
+            g.running["t1"] = {
+                "node_id": "nA", "demand": g.space.vector({"CPU": 3}),
+                "owner_conn": 1, "meta": {"task_id": "t1"},
+            }
+        # same instance re-registers (connection bounce): debits survive
+        g.rpc_register_node(dict(reg), FakeConn(2))
+        assert float(g.state.available[idx][g.space.index("CPU")]) == 1.0
+        assert "t1" in g.running
+        # NEW instance re-registers: old incarnation swept, row reset
+        g.rpc_register_node(dict(reg, instance="inst-2"), FakeConn(3))
+        assert float(g.state.available[idx][g.space.index("CPU")]) == 4.0
+        assert "t1" not in g.running
+        assert g.nodes["nA"]["alive"]
+    finally:
+        g.shutdown()
+
+
+def test_resent_task_done_does_not_reinsert_released_borrow():
+    """Regression (review finding): the borrow-record insert in
+    rpc_task_done ran on resends too, so a duplicate report landing
+    after rpc_borrow_released popped the record re-inserted a ghost
+    borrow nothing would ever release (the owner then defers the free
+    until node death)."""
+    from ray_tpu.cluster.testing import FakeConn
+
+    g = _fresh_gcs()
+    try:
+        conn = FakeConn()
+        g.rpc_register_node(
+            {"node_id": "nA", "addr": "127.0.0.1", "port": 1,
+             "resources": {"CPU": 2}}, conn)
+        with g._lock:
+            g.running["t1"] = {
+                "node_id": "nA", "demand": g.space.vector({"CPU": 1}),
+                "owner_conn": conn.conn_id, "meta": {"task_id": "t1"},
+            }
+        report = {"task_id": "t1", "node_id": "nA", "status": "FINISHED",
+                  "results": [], "start": 1.0, "end": 2.0,
+                  "borrows": [{"id": "obj-b", "owner": "drv"}],
+                  "borrow_worker": "w1"}
+        g.rpc_task_done(dict(report), conn)
+        assert ("obj-b", "w1") in g.borrows
+        g.rpc_borrow_released(
+            {"object_id": "obj-b", "worker_id": "w1", "owner": "drv"}, conn)
+        assert ("obj-b", "w1") not in g.borrows
+        g.rpc_task_done(dict(report), conn)  # watchdog resend
+        assert ("obj-b", "w1") not in g.borrows, \
+            "resent task_done re-inserted a released borrow"
+    finally:
+        g.shutdown()

@@ -160,7 +160,7 @@ def test_disabled_by_default_and_off_hot_path():
 # ================================================== live-cluster survival
 
 
-def test_job_survives_injected_gcs_connection_reset():
+def test_job_survives_injected_gcs_connection_reset(invariant_sanitizer):
     """Acceptance (a): a driver job completes correctly across an injected
     driver->GCS connection reset — RetryingRpcClient reconnects with
     backoff, replays subscriptions, re-registers, and resubmits."""
@@ -184,7 +184,7 @@ def test_job_survives_injected_gcs_connection_reset():
         cluster.shutdown()
 
 
-def test_job_survives_daemon_gcs_reset():
+def test_job_survives_daemon_gcs_reset(invariant_sanitizer):
     """A node daemon's GCS connection reset mid-job: the daemon
     re-registers (rejoin) + re-syncs, and the job still completes."""
     sched = chaos.install(FaultSchedule(seed=11, rules=[
@@ -218,7 +218,7 @@ def test_job_survives_daemon_gcs_reset():
         cluster.shutdown()
 
 
-def test_job_survives_gcs_kill_restart_midjob(tmp_path):
+def test_job_survives_gcs_kill_restart_midjob(tmp_path, invariant_sanitizer):
     """Acceptance (b): full GCS kill + restart mid-job. In-flight work
     finishes with correct results: daemons/drivers reconnect + re-register,
     the driver resubmits unfinished tasks, the GCS recovers tables from its
@@ -246,7 +246,7 @@ def test_job_survives_gcs_kill_restart_midjob(tmp_path):
         cluster.shutdown()
 
 
-def test_one_way_partition_heals():
+def test_one_way_partition_heals(invariant_sanitizer):
     """A bounded one-way partition (driver->GCS frames dropped for a
     window) delays but does not fail the job."""
     sched = chaos.install(FaultSchedule(seed=3, rules=[
@@ -268,7 +268,7 @@ def test_one_way_partition_heals():
         cluster.shutdown()
 
 
-def test_chaos_kill_at_step_with_cluster_registration():
+def test_chaos_kill_at_step_with_cluster_registration(invariant_sanitizer):
     """Cluster.add_node registers each node as a kill target; a kill_at
     rule consulted from the harness loop kills it deterministically and
     retries carry the job."""
@@ -297,7 +297,7 @@ def test_chaos_kill_at_step_with_cluster_registration():
         cluster.shutdown()
 
 
-def test_kill_targets_survive_late_install():
+def test_kill_targets_survive_late_install(invariant_sanitizer):
     """Regression: kill targets live in a process-level registry, so a
     schedule installed AFTER Cluster()/add_node() still finds them (an
     instance-bound registry made late installs silent no-ops)."""

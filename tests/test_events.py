@@ -1,0 +1,101 @@
+"""Direct unit tests for util/events.py — the structured-event ring and
+its JSONL sink. Previously exercised only indirectly through
+test_dashboard; the sink's failure path was entirely untested (and
+silently swallowed errors)."""
+
+import json
+
+import pytest
+
+from ray_tpu.util import events as ev_mod
+from ray_tpu.util.events import (
+    clear_events,
+    configure_sink,
+    list_events,
+    record_event,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_ring():
+    clear_events()
+    configure_sink(None)
+    yield
+    clear_events()
+    configure_sink(None)
+
+
+# ================================================================= the ring
+
+
+def test_ring_is_bounded():
+    for i in range(ev_mod._MAX_EVENTS + 50):
+        record_event("FLOOD", str(i))
+    evs = list_events(limit=ev_mod._MAX_EVENTS + 100)
+    assert len(evs) == ev_mod._MAX_EVENTS
+    # most-recent-first, and the oldest 50 fell off the ring
+    assert evs[0]["message"] == str(ev_mod._MAX_EVENTS + 49)
+    assert evs[-1]["message"] == "50"
+
+
+def test_list_events_filters_and_limit():
+    record_event("A", "1", severity="INFO")
+    record_event("B", "2", severity="WARNING")
+    record_event("A", "3", severity="WARNING")
+    assert [e["message"] for e in list_events(label="A")] == ["3", "1"]
+    assert [e["message"] for e in list_events(severity="WARNING")] == ["3", "2"]
+    assert len(list_events(limit=2)) == 2
+
+
+def test_record_returns_record_with_fields():
+    rec = record_event("X", "msg", source="gcs", node_id="n1")
+    assert rec["label"] == "X" and rec["node_id"] == "n1"
+    assert rec["source"] == "gcs" and "timestamp" in rec and "pid" in rec
+
+
+# ======================================================= severity fallback
+
+
+def test_unknown_severity_falls_back_to_info():
+    rec = record_event("X", "msg", severity="CATASTROPHIC")
+    assert rec["severity"] == "INFO"
+    assert list_events(severity="INFO")[0]["message"] == "msg"
+
+
+# ============================================================== JSONL sink
+
+
+def test_jsonl_sink_appends_parseable_lines(tmp_path):
+    sink = tmp_path / "events.jsonl"
+    configure_sink(str(sink))
+    record_event("S1", "first", severity="WARNING", extra=1)
+    record_event("S2", "second")
+    lines = [json.loads(l) for l in sink.read_text().splitlines()]
+    assert [l["label"] for l in lines] == ["S1", "S2"]
+    assert lines[0]["severity"] == "WARNING" and lines[0]["extra"] == 1
+
+
+def test_sink_failure_warns_once_per_path_and_keeps_ring(tmp_path, capsys):
+    bad = str(tmp_path / "no" / "such" / "dir" / "events.jsonl")
+    configure_sink(bad)
+    record_event("F", "one")
+    record_event("F", "two")
+    err = capsys.readouterr().err
+    assert err.count("event sink") == 1  # once per path, not per event
+    assert bad.split("/")[-1] in err or "events.jsonl" in err
+    # the ring kept both events despite the dead sink
+    assert [e["message"] for e in list_events(label="F")] == ["two", "one"]
+    # re-configuring the SAME path re-arms the warning
+    configure_sink(bad)
+    record_event("F", "three")
+    assert capsys.readouterr().err.count("event sink") == 1
+
+
+def test_sink_disabled_with_none(tmp_path):
+    sink = tmp_path / "events.jsonl"
+    configure_sink(str(sink))
+    record_event("S", "on")
+    configure_sink(None)
+    record_event("S", "off")
+    lines = sink.read_text().splitlines()
+    assert len(lines) == 1 and json.loads(lines[0])["message"] == "on"
