@@ -1062,7 +1062,7 @@ class ResourceSpecChecker(Checker):
 
 # Directory segments that count as control plane: a blocked thread there
 # wedges a daemon loop, the GCS, or a driver's submission path.
-_CONTROL_PLANE_SEGMENTS = {"cluster"}
+_CONTROL_PLANE_SEGMENTS = {"cluster", "dag"}
 
 
 @register

@@ -26,7 +26,13 @@ retry/replay machinery of the reconnecting control plane must preserve:
   per (object, worker); optionally, terminal outstanding count is zero;
 - **object lifecycle**: an object location is only ever recorded after a
   store put on that node, and never re-surfaces after a free without an
-  intervening re-creation (created -> sealed/put -> located -> freed).
+  intervening re-creation (created -> sealed/put -> located -> freed);
+- **channel alternation** (compiled DAGs): per edge, frame seqs written
+  by the single writer are gap-free (+1 each), every read consumes the
+  next unread seq, and no seq is read before it was written — the shm
+  seqlock's write/ack alternation, checked offline. Channel events carry
+  their happens-before through the channel header's clock words (see
+  ray_tpu/dag/channel.py), since those frames never cross the RPC layer.
 
 Activation mirrors ``ray_tpu.chaos``: a single module-global hook
 (``rpc.TRACE``) checked with ``is None`` on the hot path — zero overhead
@@ -67,6 +73,16 @@ METHOD_TABLE: Dict[str, str] = {
     "kill_actor": "actor lifetime-hold release",
     "actor_died": "actor lifetime-hold release",
     "stream_item": "object lifecycle (located)",
+    # compiled DAGs (ray_tpu/dag): stage capacity holds follow the same
+    # dispatch/release ledger as tasks; channel frames follow the per-edge
+    # seq-alternation invariant (chan_write/chan_read apply events emitted
+    # by the exec loops, clocks carried through the shm header)
+    "dag_register": "dag stage capacity holds (dispatch)",
+    "dag_teardown": "dag stage capacity release + channel teardown",
+    "dag_worker_died": "dag broken propagation + stage-hold release",
+    "dag_start_stage": "stage worker pinning",
+    "dag_push": "channel frame deposit (chan seq alternation)",
+    "dag_pull": "channel frame consume (chan seq alternation)",
 }
 
 _EPS = 1e-4
@@ -129,11 +145,23 @@ class ProtocolTracer:
 
     # ---------------------------------------------------- apply events
 
-    def apply(self, kind: str, **fields: Any) -> None:
-        """Application-level state mutation (GCS/daemon/client hooks)."""
+    def apply(self, kind: str, **fields: Any) -> int:
+        """Application-level state mutation (GCS/daemon/client hooks).
+        Returns the event's Lamport clock — shm channels stamp it into
+        their header so the peer process can merge it (frames there never
+        cross the RPC layer, where ``_lc`` would normally carry it)."""
         rec: Dict[str, Any] = {"t": "apply", "k": kind}
         rec.update(fields)
-        self._emit(rec)
+        return self._emit(rec)
+
+    def merge_clock(self, remote_clock: Optional[int]) -> None:
+        """Fold a peer clock received out-of-band (e.g. a channel header
+        word) into this process's clock, preserving happens-before."""
+        if not remote_clock:
+            return
+        with self._lock:
+            if remote_clock > self._clock:
+                self._clock = int(remote_clock)
 
     def close(self) -> None:
         with self._lock:
@@ -232,6 +260,14 @@ class InvariantChecker:
         # object lifecycle: oid -> {"nodes": set, "freed": clock|None,
         #                           "put_after_free": bool}
         self.objects: Dict[str, Dict[str, Any]] = {}
+        # compiled-DAG channels: key -> {"w": last written seq,
+        # "r": last read seq, "reads_seen"/"writes_seen": bool}. The
+        # cross-side checks (write overrun, read-before-write) arm only
+        # once BOTH sides are witnessed on the edge — a topology where
+        # only one end traces (e.g. the driver with worker subprocesses
+        # lacking RAY_TPU_TRACE_FILE) must not self-flag; the same-side
+        # seq-continuity checks always hold.
+        self.channels: Dict[str, Dict[str, Any]] = {}
 
     # ------------------------------------------------------------ helpers
 
@@ -485,6 +521,43 @@ class InvariantChecker:
         o = self.objects.get(ev["oid"])
         if o is not None:
             o["freed"] = ev["c"]
+
+    # --- compiled-DAG channel alternation (ray_tpu/dag/channel.py) ---
+
+    def _chan(self, key: str) -> Dict[str, Any]:
+        return self.channels.setdefault(
+            key, {"w": 0, "r": 0, "reads_seen": False, "writes_seen": False}
+        )
+
+    def _on_chan_write(self, ev: Dict) -> None:
+        st = self._chan(ev["chan"])
+        seq = int(ev["seq"])
+        st["writes_seen"] = True
+        if seq != st["w"] + 1:
+            self._bad("channel", ev["c"],
+                      f"channel {ev['chan']}: write seq {seq} after seq "
+                      f"{st['w']} (gap or duplicate — single-writer seq "
+                      "must advance by exactly 1)")
+        elif st["reads_seen"] and st["r"] != st["w"]:
+            self._bad("channel", ev["c"],
+                      f"channel {ev['chan']}: write seq {seq} before frame "
+                      f"{st['r'] + 1} was consumed (writer overran the "
+                      "reader ack — backpressure broken)")
+        st["w"] = max(st["w"], seq)
+
+    def _on_chan_read(self, ev: Dict) -> None:
+        st = self._chan(ev["chan"])
+        seq = int(ev["seq"])
+        st["reads_seen"] = True
+        if st["writes_seen"] and seq > st["w"]:
+            self._bad("channel", ev["c"],
+                      f"channel {ev['chan']}: read seq {seq} before it was "
+                      f"written (last write {st['w']}) — read-before-write")
+        elif seq != st["r"] + 1:
+            self._bad("channel", ev["c"],
+                      f"channel {ev['chan']}: read seq {seq} after seq "
+                      f"{st['r']} (skipped or re-read a frame)")
+        st["r"] = max(st["r"], seq)
 
 
 def check_trace(path: str, strict_terminal: bool = False) -> List[Violation]:

@@ -150,6 +150,10 @@ class ClusterClient:
         # reference. FIFO-capped.
         self._func_cache: "OrderedDict[int, tuple]" = OrderedDict()
         self._FUNC_CACHE_MAX = 512
+        # compiled-DAG state pushed by the GCS (dag_update): dag_id ->
+        # {"state", "error"}; CompiledDAG.execute polls it so a dead
+        # pipeline raises ChannelClosedError instead of parking forever
+        self._dag_states: Dict[str, dict] = {}
         # error-object publication queue: one shared publisher thread (see
         # _publish_error); entries are (refs, payload, deadline)
         self._err_pub_q: list = []
@@ -190,6 +194,7 @@ class ClusterClient:
         self.gcs.subscribe("borrow_added", self._on_borrow_added)
         self.gcs.subscribe("borrow_released", self._on_borrow_released)
         self.gcs.subscribe("worker_logs", self._on_worker_logs)
+        self.gcs.subscribe("dag_update", self._on_dag_update)
         self.gcs.connect()
         self._put_rr = 0
         self._gc_thread = threading.Thread(
@@ -1384,6 +1389,27 @@ class ClusterClient:
     def free(self, refs: List[ObjectRef]):
         self.store.delete(refs)
         self.gcs.call("free_objects", {"object_ids": [r.id for r in refs]}, timeout=self._rpc_timeout)
+
+    # ------------------------------------------------------- compiled DAGs
+
+    def _on_dag_update(self, p: dict) -> None:
+        with self._lock:
+            ent = self._dag_states.setdefault(p["dag_id"], {})
+            ent["state"] = p.get("state")
+            ent["error"] = p.get("error")
+
+    def dag_register(self, payload: dict) -> dict:
+        return self.gcs.call("dag_register", payload, timeout=self._rpc_timeout)
+
+    def dag_teardown(self, dag_id: str) -> dict:
+        with self._lock:
+            self._dag_states.pop(dag_id, None)
+        return self.gcs.call("dag_teardown", {"dag_id": dag_id},
+                             timeout=self._rpc_timeout)
+
+    def dag_state(self, dag_id: str) -> dict:
+        with self._lock:
+            return dict(self._dag_states.get(dag_id) or {})
 
     # ---------------------------------------------------------------- misc
 

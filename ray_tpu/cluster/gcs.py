@@ -47,6 +47,10 @@ class GcsServer:
         self.jobs: Dict[str, dict] = {}
         self.placement_groups: Dict[str, dict] = {}
         self.kv: Dict[str, bytes] = {}
+        # compiled-DAG registry (ray_tpu/dag): dag_id -> {owner, stages,
+        # holds, state}; stage capacity holds live in self.running under
+        # "dag-hold-<dag>-<stage>" keys (like actor lifetime holds)
+        self.dags: Dict[str, dict] = {}
         self.directory: Dict[str, set] = defaultdict(set)  # object_id -> {node_id}
         self.drivers: Dict[int, dict] = {}  # conn_id -> {driver_id}
         # GCS-initiated request/response clients to node daemons (the push
@@ -281,6 +285,7 @@ class GcsServer:
                 "labels": p.get("labels", {}),
                 "shm_name": p.get("shm_name"),
                 "instance": p.get("instance"),
+                "chan_dir": p.get("chan_dir"),
             }
             # recorded only after the entry commits (a malformed payload
             # must not leave an event for a node that never joined); rejoin
@@ -1162,6 +1167,190 @@ class GcsServer:
                 "nodes": nodes,
             }
 
+    # ------------------------------------------------------ compiled DAGs
+    # (ray_tpu/dag; reference: Ray Compiled Graphs. The GCS's role is
+    # compile-time only: pack function stages onto nodes with the SAME
+    # batched kernel the task scheduler uses (sched/policy.py), hold their
+    # capacity for the DAG's lifetime, resolve actor stages to the nodes
+    # already hosting them, and propagate death/teardown. The iteration
+    # hot path never comes back here.)
+
+    def rpc_dag_register(self, p, conn):
+        with self._lock:
+            dag_id = p["dag_id"]
+            if dag_id in self.dags:
+                return {"ok": False, "error": f"dag {dag_id} already registered"}
+            stages = p["stages"]
+            placements: List[dict] = []
+            for s in stages:
+                if not s.get("actor_id"):
+                    continue
+                a = self.actors.get(s["actor_id"])
+                if a is None or a.get("state") == "DEAD":
+                    return {"ok": False,
+                            "error": f"actor {s['actor_id']} is dead/unknown"}
+                if a.get("state") != "ALIVE" or not a.get("node_id"):
+                    # creation still in flight: the driver retries briefly
+                    return {"ok": False, "retry": True,
+                            "error": f"actor {s['actor_id']} not ALIVE yet"}
+                placements.append({"stage": s["stage"],
+                                   "node_id": a["node_id"]})
+            func_stages = [s for s in stages if not s.get("actor_id")]
+            holds: Dict[int, str] = {}
+            if func_stages:
+                demands = np.stack([
+                    self.space.vector(s.get("resources") or {"CPU": 1.0})
+                    for s in func_stages
+                ])
+                counts = np.ones(len(func_stages), np.int32)
+                rows: List[Optional[int]] = []
+                if (
+                    getattr(self.policy, "pipelined", False)
+                    and self.policy.has_inflight()
+                ):
+                    # a pipelined device window is in flight: plain
+                    # schedule() against the host view would ignore the
+                    # window's on-device debits and force a full-window
+                    # discard (see policy.py _flush_pipe). Out-of-band
+                    # allocations through state.allocate are delta-logged
+                    # and ship to the device mid-window — same path the
+                    # special-strategy scheduler uses.
+                    from ray_tpu.sched import kernel_np
+
+                    for c in range(len(func_stages)):
+                        feas = kernel_np.feasible_mask(
+                            self.state.available, self.state.alive,
+                            demands[c],
+                        )
+                        if not feas.any():
+                            rows.append(None)
+                            continue
+                        score = kernel_np.node_scores(
+                            self.state.available, self.state.total,
+                            self.config.scheduler_spread_threshold,
+                        )
+                        score = np.where(feas, score, np.float32(np.inf))
+                        idx = int(np.argmin(score))
+                        rows.append(
+                            idx if self.state.allocate(idx, demands[c])
+                            else None
+                        )
+                else:
+                    # stage→node packing = one batched kernel round over
+                    # the live availability view (the kernel debits it;
+                    # releases happen at teardown / stage death)
+                    assigned = self.policy.schedule(
+                        self.state, demands, counts
+                    )
+                    for c in range(len(func_stages)):
+                        nz = np.flatnonzero(assigned[c])
+                        rows.append(int(nz[0]) if len(nz) else None)
+                if any(r is None for r in rows):
+                    for c, r in enumerate(rows):  # credit the placed back
+                        if r is not None:
+                            self.state.release(r, demands[c])
+                    return {"ok": False, "retry": True,
+                            "error": "insufficient capacity for dag stages"}
+                for c, s in enumerate(func_stages):
+                    nid = self.state.node_ids[rows[c]]
+                    hold_key = f"dag-hold-{dag_id}-{s['stage']}"
+                    self.running[hold_key] = {
+                        "node_id": nid, "demand": demands[c],
+                        "owner_conn": conn.conn_id, "meta": {},
+                    }
+                    holds[s["stage"]] = hold_key
+                    if rpc_mod.TRACE is not None:
+                        rpc_mod.TRACE.apply(
+                            "dispatch", task=hold_key, node=nid,
+                            res=self.space.unvector(demands[c]),
+                        )
+                    placements.append({"stage": s["stage"], "node_id": nid})
+            for pl in placements:
+                n = self.nodes.get(pl["node_id"]) or {}
+                pl["addr"] = n.get("addr")
+                pl["port"] = n.get("port")
+                pl["chan_dir"] = n.get("chan_dir")
+            self.dags[dag_id] = {
+                "dag_id": dag_id,
+                "owner": p.get("owner"),
+                "owner_conn": conn.conn_id,
+                "state": "RUNNING",
+                "error": None,
+                "stages": {pl["stage"]: pl["node_id"] for pl in placements},
+                "holds": holds,
+            }
+        return {"ok": True, "placements": placements}
+
+    def _release_dag_hold_locked(self, hold_key: str) -> None:
+        info = self.running.pop(hold_key, None)
+        if info is None:
+            return  # already released / wiped with its node
+        idx = self.state.node_index(info["node_id"])
+        if idx is not None and self.state.alive[idx]:
+            self.state.release(idx, info["demand"])
+        if rpc_mod.TRACE is not None:
+            rpc_mod.TRACE.apply(
+                "release", key=hold_key, node=info["node_id"]
+            )
+        self._pg_retry_needed = True
+
+    def rpc_dag_teardown(self, p, conn):
+        """Driver -> GCS: release every stage hold, tell every involved
+        daemon to close channels and unpin workers. Idempotent."""
+        with self._lock:
+            dag = self.dags.pop(p["dag_id"], None)
+            nodes = set()
+            if dag is not None:
+                nodes = set(dag["stages"].values())
+                for hold_key in dag["holds"].values():
+                    self._release_dag_hold_locked(hold_key)
+        for nid in nodes:
+            self._push_to_node(nid, "dag_teardown", {"dag_id": p["dag_id"]})
+        self._kick()
+        return {"ok": True}
+
+    def rpc_dag_worker_died(self, p, conn):
+        """Daemon report: a pinned stage worker died. Release the stage's
+        hold, mark the DAG broken, tell the owner (whose parked execute
+        raises ChannelClosedError instead of hanging)."""
+        with self._lock:
+            dag = self.dags.get(p["dag_id"])
+            if dag is None:
+                return {"ok": True}
+            hold_key = dag["holds"].pop(p.get("stage"), None)
+            if hold_key:
+                self._release_dag_hold_locked(hold_key)
+            already = dag["state"] == "BROKEN"
+            dag["state"] = "BROKEN"
+            dag["error"] = dag.get("error") or p.get("error") \
+                or "dag stage worker died"
+            target = None if already else self._driver_conn(
+                dag.get("owner_conn"), dag.get("owner")
+            )
+            payload = {"dag_id": p["dag_id"], "state": "BROKEN",
+                       "error": dag["error"]}
+        if target is not None:
+            self._push_conn(target, "dag_update", payload)
+        self._kick()
+        return {"ok": True}
+
+    def rpc_dag_spans(self, p, conn):
+        """Per-iteration stage spans from the exec loops, merged into the
+        task-event log so the timeline shows hot-loop occupancy."""
+        base = int(p.get("base") or 0)
+        name = p.get("name") or "stage"
+        for i, (start, end) in enumerate(p.get("spans") or ()):
+            self.task_events.append({
+                "task_id": f"{p['dag_id']}:{p['stage']}:{base + i}",
+                "name": f"dag:{name}",
+                "status": "DAG_ITER",
+                "start": start,
+                "end": end,
+                "node_id": p.get("node_id"),
+                "stage": f"{name}#{p['stage']}",
+            })
+        return {"ok": True}
+
     # ------------------------------------------------------- placement groups
 
     def _daemon_client(self, node_id: str) -> Optional[RpcClient]:
@@ -1776,6 +1965,7 @@ class GcsServer:
         if node_id:
             self._mark_node_dead(node_id, "daemon connection lost")
         if driver_id:
+            dag_sweep = []  # (dag_id, nodes) torn down with their driver
             with self._lock:
                 self.drivers.pop(conn.conn_id, None)
                 # a RetryingRpcClient reconnect re-registers on a NEW conn
@@ -1787,6 +1977,23 @@ class GcsServer:
                 )
                 if not still_here and driver_id in self.jobs:
                     self.jobs[driver_id]["state"] = "FINISHED"
+                if not still_here:
+                    # a dead driver's compiled DAGs would pin their workers
+                    # and capacity forever: tear them down on its behalf
+                    for dag_id, dag in list(self.dags.items()):
+                        if dag.get("owner") != driver_id:
+                            continue
+                        del self.dags[dag_id]
+                        for hold_key in dag["holds"].values():
+                            self._release_dag_hold_locked(hold_key)
+                        dag_sweep.append(
+                            (dag_id, set(dag["stages"].values()))
+                        )
+            for dag_id, nodes in dag_sweep:
+                for nid in nodes:
+                    self._push_to_node(
+                        nid, "dag_teardown", {"dag_id": dag_id}
+                    )
 
     def _health_loop(self):
         period = self.config.health_check_period_ms / 1000.0
@@ -1952,6 +2159,26 @@ class GcsServer:
                     target = self._conn_for_driver_id(rec.get("owner"))
                     if target is not None:
                         borrow_releases.append((target, oid, wid))
+            # compiled DAGs with a stage pinned to the dead node lose their
+            # pipeline: mark broken, tell the owner (its parked execute
+            # raises ChannelClosedError). Stage holds on the dead node were
+            # already popped with lost_tasks; survivors release at teardown.
+            dag_updates = []
+            for dag in self.dags.values():
+                if (
+                    dag.get("state") == "RUNNING"
+                    and node_id in dag["stages"].values()
+                ):
+                    dag["state"] = "BROKEN"
+                    dag["error"] = f"dag stage node {node_id} died: {cause}"
+                    t = self._driver_conn(
+                        dag.get("owner_conn"), dag.get("owner")
+                    )
+                    if t is not None:
+                        dag_updates.append((t, {
+                            "dag_id": dag["dag_id"], "state": "BROKEN",
+                            "error": dag["error"],
+                        }))
             dead_actors = [
                 a for a in self.actors.values()
                 if a["node_id"] == node_id and a["state"] in ("ALIVE", "STARTING")
@@ -1972,6 +2199,8 @@ class GcsServer:
             # is restarting must not also be retried by the driver
             if tid.startswith("actor-hold-"):
                 continue  # lifetime holds, not real tasks; actor FT above
+            if tid.startswith("dag-hold-"):
+                continue  # dag stage holds; owner notified via dag_update
             meta = info.get("meta", {})
             if meta.get("actor_creation") and \
                     meta.get("actor_id") in restarted_actor_ids:
@@ -1997,6 +2226,8 @@ class GcsServer:
             self._push_conn(target, "borrow_released", {
                 "object_id": oid, "worker_id": wid,
             })
+        for target, payload in dag_updates:
+            self._push_conn(target, "dag_update", payload)
         for aid, state in actor_updates:
             self.server.broadcast(
                 "actor_update", {"actor_id": aid, "state": state}

@@ -178,6 +178,8 @@ class _Worker:
         self.busy = False
         self.actor_id: Optional[str] = None
         self.current_task: Optional[dict] = None
+        # compiled-DAG stages pinned to this worker: {(dag_id, stage)}
+        self.dag_stages: set = set()
 
 
 class NodeDaemon:
@@ -225,6 +227,17 @@ class NodeDaemon:
         self.workers: Dict[str, _Worker] = {}
         self._idle: deque = deque()
         self._task_queue: deque = deque()  # tasks waiting for a worker
+        # --- compiled-DAG state (ray_tpu/dag): per-dag pinned stages and
+        # the channel files living on this node. chan_dir is advertised in
+        # register_node so same-host drivers map channels directly.
+        self.chan_dir = os.path.join(
+            self.config.session_dir_root, "dagchan", self.node_id
+        )
+        os.makedirs(self.chan_dir, exist_ok=True)
+        self._dags: Dict[str, dict] = {}  # dag_id -> {stages, keys}
+        self._chan_paths: Dict[str, str] = {}  # channel key -> local path
+        self._chan_index: Dict[str, Any] = {}  # key -> Channel this daemon holds
+        self._dag_pending: deque = deque()  # stage specs awaiting a worker
         self._actor_tasks: Dict[str, dict] = {}  # task_id -> meta (actor rpc futures)
         self._pending_rpc: Dict[str, Any] = {}  # task_id -> asyncio future (actor calls)
         self._peer_clients: Dict[str, RpcClient] = {}
@@ -282,6 +295,7 @@ class NodeDaemon:
             "free_objects", lambda p: self.store.delete(p["object_ids"])
         )
         self.gcs.subscribe("return_bundle", self._on_return_bundle)
+        self.gcs.subscribe("dag_teardown", self._on_dag_teardown)
         self.gcs.subscribe("nodes", self._on_nodes_update)
         self.gcs.connect()
         self._beat_thread = threading.Thread(
@@ -306,6 +320,7 @@ class NodeDaemon:
             "node_id": self.node_id, "addr": self.host, "port": self.port,
             "resources": self.resources, "labels": self._labels,
             "shm_name": self.shm_name, "instance": self.instance,
+            "chan_dir": self.chan_dir,
         }, timeout=timeout)
         assert reply["ok"]
         if not first:
@@ -442,6 +457,12 @@ class NodeDaemon:
                 })
             except Exception:  # noqa: BLE001
                 pass
+        if w and w.dag_stages:
+            # a pinned compiled-DAG worker died mid-iteration: flag every
+            # channel of its DAGs on this node CLOSED|ERROR (parked
+            # readers/writers wake with ChannelClosedError, never hang)
+            # and report up — the GCS pushes dag_update to the owner
+            self._on_dag_worker_died(w)
         if w and w.current_task:
             # worker crashed mid-task -> report failure (reference:
             # NodeManager worker death handling -> task failure)
@@ -490,6 +511,7 @@ class NodeDaemon:
                 self.workers[worker_id] = w
             w.conn = conn
             self._idle.append(worker_id)
+        self._pump_dag_stages()
         self._pump()
         return {"ok": True, "node_id": self.node_id}
 
@@ -1106,6 +1128,275 @@ class NodeDaemon:
                 w.proc.terminate()
             except OSError:
                 pass
+
+    # --- compiled-DAG stages + channels (ray_tpu/dag; reference: Ray
+    # Compiled Graphs — the daemon pins one worker per stage, owns the
+    # writable end of channels deposited by remote writers, and relays
+    # cross-node frames over dag_push/dag_pull) ---
+
+    def _dag_ent(self, dag_id: str) -> dict:
+        return self._dags.setdefault(dag_id, {"stages": {}, "keys": set()})
+
+    def rpc_dag_start_stage(self, p, conn):
+        """Driver -> daemon: pin a worker and start a compiled-DAG stage's
+        exec loop. Pre-creates daemon-owned deposit channels (in-edges
+        whose writer is remote), then pushes the static loop spec to a
+        dedicated worker; resolves once the worker reports dag_stage_ready."""
+        from ray_tpu.dag.channel import Channel
+
+        if self._stopped:
+            return {"ok": False, "error": "daemon stopping"}
+        dag_id, stage, spec = p["dag_id"], p["stage"], p["spec"]
+        ent = self._dag_ent(dag_id)
+        for c in p.get("own_channels") or ():
+            if c["key"] not in self._chan_index:
+                self._chan_index[c["key"]] = Channel.create(
+                    c["path"], int(p.get("capacity") or 65536), c["key"]
+                )
+            ent["keys"].add(c["key"])
+            self._chan_paths[c["key"]] = c["path"]
+        for e in list(spec.get("in_edges") or ()) + [
+            e for e in spec.get("out_edges") or () if not e.get("remote")
+        ]:
+            ent["keys"].add(e["key"])
+            self._chan_paths[e["key"]] = e["path"]
+        aid = p.get("actor_id")
+        fut = self.server.loop.create_future()
+        self._pending_rpc[f"dagstage-{dag_id}-{stage}"] = fut
+        if aid:
+            # actor-bound stage: the loop runs on the worker already
+            # hosting the actor (actors stay where they live)
+            with self._lock:
+                w = next(
+                    (w for w in self.workers.values() if w.actor_id == aid),
+                    None,
+                )
+            if w is None or w.conn is None:
+                self._pending_rpc.pop(f"dagstage-{dag_id}-{stage}", None)
+                return {"ok": False,
+                        "error": f"actor {aid} not hosted on {self.node_id}"}
+            self._dispatch_dag_stage(w, dag_id, stage, spec)
+            return fut
+        with self._lock:
+            w = None
+            while self._idle:
+                w = self.workers.get(self._idle.popleft())
+                if w is not None and w.conn is not None:
+                    break
+                w = None
+            if w is not None:
+                w.busy = True
+        if w is not None:
+            self._dispatch_dag_stage(w, dag_id, stage, spec)
+        else:
+            # no ready worker: park the spec; rpc_worker_ready drains it
+            self._dag_pending.append((dag_id, stage, spec))
+            self._spawn_worker()
+        return fut
+
+    def _pump_dag_stages(self):
+        """Hand parked dag stages to ready workers (called on worker_ready
+        — dag stages outrank the task queue: each one was explicitly
+        provisioned a pinned worker)."""
+        while True:
+            with self._lock:
+                if not self._dag_pending:
+                    return
+                w = None
+                while self._idle:
+                    w = self.workers.get(self._idle.popleft())
+                    if w is not None and w.conn is not None:
+                        break
+                    w = None
+                if w is None:
+                    return
+                w.busy = True
+                dag_id, stage, spec = self._dag_pending.popleft()
+            self._dispatch_dag_stage(w, dag_id, stage, spec)
+
+    def _dispatch_dag_stage(self, w: "_Worker", dag_id: str, stage: int,
+                            spec: dict):
+        w.dag_stages.add((dag_id, stage))
+        self._dag_ent(dag_id)["stages"][stage] = w.worker_id
+        self.server.call_soon(
+            lambda c=w.conn, s=spec: asyncio.ensure_future(
+                c.push("dag_loop", s)
+            )
+        )
+
+    def rpc_dag_stage_ready(self, p, conn):
+        """Worker notify: the exec loop is up, out-channels created."""
+        fut = self._pending_rpc.pop(
+            f"dagstage-{p['dag_id']}-{p['stage']}", None
+        )
+        if fut is not None:
+            self.server.call_soon(
+                lambda: fut.set_result({"ok": True})
+                if not fut.done() else None
+            )
+        return {"ok": True}
+
+    def rpc_dag_stage_exit(self, p, conn):
+        """Worker notify: its exec loop finished (teardown or upstream
+        close) — release the worker pin back to the pool."""
+        worker_id = conn.meta.get("worker_id")
+        with self._lock:
+            w = self.workers.get(worker_id)
+            if w is not None:
+                w.dag_stages.discard((p["dag_id"], p["stage"]))
+                if (
+                    not w.dag_stages and w.actor_id is None
+                    and w.current_task is None and w.busy
+                ):
+                    w.busy = False
+                    self._idle.append(worker_id)
+        ent = self._dags.get(p["dag_id"])
+        if ent is not None:
+            ent["stages"].pop(p["stage"], None)
+        self._pump()
+        return {"ok": True}
+
+    def rpc_dag_push(self, p, conn):
+        """Cross-node edge deposit: a remote writer (worker or driver)
+        hands a frame to the channel this daemon owns. Blocking (channel
+        backpressure) — runs off the event loop."""
+        ch = self._chan_index.get(p["key"])
+        if ch is None:
+            return {"ok": False,
+                    "error": f"no channel {p['key']} on {self.node_id}"}
+        if p.get("close"):
+            ch.close(error=bool(p.get("error")))
+            return {"ok": True}
+        payload = p.get("payload")
+        return self.server.loop.run_in_executor(
+            None, lambda: self._dag_deposit(ch, payload)
+        )
+
+    @staticmethod
+    def _dag_deposit(ch, payload) -> dict:
+        try:
+            ch.write(payload, timeout=60.0)
+            return {"ok": True}
+        except Exception as e:  # noqa: BLE001 - surface to the pusher
+            return {"ok": False, "error": repr(e)}
+
+    def rpc_dag_pull(self, p, conn):
+        """Remote-driver read of an output edge: the daemon attaches the
+        channel's read end locally and consumes on the driver's behalf
+        (the ack word needs a same-host writable mapping)."""
+        timeout = float(p.get("timeout") or 30.0)
+        return self.server.loop.run_in_executor(
+            None, lambda: self._dag_pull_frame(p["key"], timeout)
+        )
+
+    def _dag_pull_frame(self, key: str, timeout: float) -> dict:
+        from ray_tpu.dag.channel import (
+            Channel,
+            ChannelClosedError,
+            ChannelTimeoutError,
+        )
+
+        ch = self._chan_index.get(key)
+        if ch is None:
+            path = self._chan_paths.get(key)
+            if path is None:
+                return {"ok": False, "closed": True}
+            try:
+                ch = Channel.open_wait(path, key, timeout=timeout)
+            except (ChannelClosedError, ChannelTimeoutError):
+                return {"ok": False, "closed": False}
+            self._chan_index[key] = ch
+        try:
+            seq, payload = ch.read(timeout=timeout)
+            return {"ok": True, "seq": seq, "payload": payload}
+        except ChannelClosedError:
+            return {"ok": False, "closed": True}
+        except Exception:  # noqa: BLE001 - timeout or torn mapping
+            return {"ok": False, "closed": False}
+
+    def rpc_dag_spans(self, p, conn):
+        """Worker notify: a batch of per-iteration (start, end) spans from
+        a stage's exec loop; relayed to the GCS task-event log so
+        `ray_tpu timeline` shows per-stage occupancy of the hot loop."""
+        try:
+            self.gcs.call_async("dag_spans", {
+                "dag_id": p["dag_id"], "stage": p["stage"],
+                "name": p.get("name"), "base": p.get("base") or 0,
+                "node_id": self.node_id, "spans": p.get("spans") or [],
+            }).add_done_callback(log_rpc_failure)
+        except Exception:  # noqa: BLE001 - gcs reconnecting
+            pass
+        return {"ok": True}
+
+    def _on_dag_worker_died(self, w: "_Worker"):
+        from ray_tpu.dag import channel as _chan
+
+        for dag_id, stage in list(w.dag_stages):
+            ent = self._dags.get(dag_id)
+            if ent is not None:
+                ent["stages"].pop(stage, None)
+                for key in ent["keys"]:
+                    path = self._chan_paths.get(key)
+                    if path:
+                        _chan.poke_error(path)
+            # died before reporting ready: fail the driver's pending
+            # dag_start_stage instead of letting it ride out its timeout
+            fut = self._pending_rpc.pop(f"dagstage-{dag_id}-{stage}", None)
+            if fut is not None:
+                self.server.call_soon(
+                    lambda f=fut, s=stage: f.set_result({
+                        "ok": False,
+                        "error": f"stage {s} worker died before ready",
+                    }) if not f.done() else None
+                )
+            try:
+                self.gcs.call_async("dag_worker_died", {
+                    "dag_id": dag_id, "stage": stage,
+                    "error": f"dag stage {stage} worker {w.worker_id} died "
+                             f"on {self.node_id} (exit {w.proc.poll() if w.proc else '?'})",
+                }).add_done_callback(log_rpc_failure)
+            except Exception:  # noqa: BLE001 - gcs reconnecting
+                pass
+
+    def _on_dag_teardown(self, p):
+        """GCS push: release the DAG's channels and worker pins on this
+        node. Idempotent — a second teardown finds nothing."""
+        from ray_tpu.dag.channel import Channel
+
+        dag_id = p["dag_id"]
+        ent = self._dags.pop(dag_id, None)
+        if ent is None:
+            return
+        with self._lock:
+            stage_workers = [
+                self.workers.get(wid) for wid in set(ent["stages"].values())
+            ]
+        for w in stage_workers:
+            if w is not None and w.conn is not None:
+                self.server.call_soon(
+                    lambda c=w.conn: asyncio.ensure_future(
+                        c.push("dag_stop", {"dag_id": dag_id})
+                    )
+                )
+        for key in ent["keys"]:
+            ch = self._chan_index.pop(key, None)
+            path = self._chan_paths.pop(key, None)
+            if ch is not None:
+                try:
+                    ch.close()
+                    ch.detach()
+                except Exception:  # noqa: BLE001
+                    pass
+            elif path:
+                # close in place so a still-draining end wakes up
+                try:
+                    c = Channel.open_wait(path, key, timeout=0.01)
+                    c.close()
+                    c.detach()
+                except Exception:  # noqa: BLE001
+                    pass
+            if path:
+                Channel.unlink(path)
 
     # --- 2PC bundle protocol, GCS-initiated (reference:
     # placement_group_resource_manager.cc Prepare/Commit/ReturnBundle;

@@ -315,6 +315,15 @@ class RemoteFunction:
             )
         return refs[0] if num_returns == 1 else refs
 
+    def bind(self, *args, **kwargs):
+        """Lazy DAG construction (reference: ray.dag — fn.bind): returns a
+        FunctionNode instead of submitting; DAGNode arguments become graph
+        edges. ``node.execute(x)`` eager-interprets via .remote();
+        ``node.compile()`` builds a pinned-worker pipeline (ray_tpu.dag)."""
+        from ray_tpu.dag.api import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
     def __call__(self, *args, **kwargs):
         raise TypeError(
             "Remote functions cannot be called directly; use .remote()."
@@ -339,6 +348,14 @@ class ActorMethod:
         return self._handle._invoke(
             self._method_name, args, kwargs, self._num_returns
         )
+
+    def bind(self, *args, **kwargs):
+        """Lazy DAG construction (reference: ray.dag — actor.method.bind):
+        the resulting stage stays pinned to the worker hosting this actor
+        when the graph is compiled (ray_tpu.dag)."""
+        from ray_tpu.dag.api import ClassMethodNode
+
+        return ClassMethodNode(self._handle, self._method_name, args, kwargs)
 
 
 class ActorHandle:

@@ -74,6 +74,13 @@ _DEFS: Dict[str, tuple] = {
     # sub-deadline per retryable attempt (a lost frame costs one attempt
     # window, not the whole call budget)
     "rpc_retry_attempt_timeout_s": (float, 5.0),
+    # --- compiled execution graphs (ray_tpu/dag/) ---
+    # initial payload area per edge channel; channels grow in place (the
+    # writer ftruncates + remaps) when a frame exceeds it
+    "dag_channel_buffer_bytes": (int, 65536),
+    # default per-iteration deadline for CompiledDAG.execute — bounds every
+    # channel wait so a dead pipeline raises instead of parking forever
+    "dag_execute_timeout_s": (float, 60.0),
     "num_workers_soft_limit": (int, 0),  # 0 -> num_cpus
     "worker_start_timeout_s": (float, 30.0),
     "metrics_report_interval_ms": (float, 2000.0),

@@ -1,0 +1,305 @@
+"""Single-writer single-reader mutable channels for compiled DAGs.
+
+Reference: Ray Compiled Graphs (aDAG) pre-allocate one *mutable* plasma
+object per DAG edge and drive iterations by rewriting it in place
+(python/ray/experimental/channel/), so the steady-state loop never touches
+the control plane. Same design here, adapted to this repo's store: the
+native shm segment hands non-creating processes read-only views, so a
+channel cannot live inside it — each edge instead gets its own small
+file-backed shm mapping (``mmap`` over a file under the daemon's channel
+dir, tmpfs when session_dir_root points there), which every same-host
+process can map read-write. Cross-node edges fall back to a push over the
+daemon RPC transfer path (``rpc_dag_push`` / ``rpc_dag_pull``).
+
+Seqlock layout (128-byte header, little-endian u64 words, payload after):
+
+====  =========  ====================================================
+word  name       meaning
+====  =========  ====================================================
+0     magic      0x52544348 ("RTCH"); readers poll for it (creation)
+1     flags      bit0 CLOSED (graceful), bit1 ERROR (peer died)
+2     version    seq of the last committed frame (0 = none yet)
+3     ack        seq of the last consumed frame
+4     len        payload byte length of the current frame
+5     reserved   (frame flags; unused — error-ness rides the payload)
+6     wclock     writer's Lamport clock at commit (trace merge)
+7     rclock     reader's Lamport clock at ack (trace merge)
+8     capacity   payload-area size; readers remap when len exceeds
+                 what they mapped (writer grows the file in place)
+====  =========  ====================================================
+
+Protocol (strict alternation — the invariant the exec loop traces):
+the writer blocks until ``ack == version`` (reader consumed the previous
+frame: backpressure), writes payload then bumps ``version``; the reader
+blocks on a version bump, copies the payload, then advances ``ack``.
+Blocking is adaptive polling (spin, then sleep) — same-host latency is a
+few microseconds and no cross-process futex is portable from Python.
+
+Happens-before: ``wclock``/``rclock`` carry each side's Lamport clock
+through the shared memory (frames here never cross the RPC layer, so the
+tracer's usual ``_lc`` piggyback cannot order them); each side merges the
+peer's clock before emitting its ``chan_write``/``chan_read`` apply event,
+so the offline invariant checker sees reads sorted after their writes.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import struct
+import time
+from typing import Callable, Optional, Tuple
+
+from ray_tpu.core.exceptions import GetTimeoutError, RayTpuError
+
+MAGIC = 0x52544348  # "RTCH"
+HDR = 128
+FLAG_CLOSED = 1
+FLAG_ERROR = 2
+
+_W_MAGIC, _W_FLAGS, _W_VERSION, _W_ACK, _W_LEN, _W_FFLAGS, _W_WCLOCK, \
+    _W_RCLOCK, _W_CAP = range(9)
+
+_U64 = struct.Struct("<Q")
+
+
+class ChannelClosedError(RayTpuError):
+    """The peer end of a compiled-DAG channel is gone (teardown, or a
+    pinned worker / its node died mid-iteration)."""
+
+
+class ChannelTimeoutError(GetTimeoutError):
+    """A channel read/write exceeded its deadline."""
+
+
+def _tracer():
+    from ray_tpu.cluster import rpc as _rpc
+
+    return _rpc.TRACE
+
+
+class Channel:
+    """One end of a single-writer single-reader seqlock channel.
+
+    Both ends map the same file read-write; ``write``/``read`` enforce the
+    SPSC alternation. The creating (writer) side sizes the file; readers
+    attach with :meth:`open_wait`, polling for the magic word.
+    """
+
+    def __init__(self, path: str, mm: mmap.mmap, fd: int, key: str):
+        self.path = path
+        self.key = key
+        self._mm = mm
+        self._fd = fd
+        self._closed_local = False
+
+    # ------------------------------------------------------------ lifecycle
+
+    @classmethod
+    def create(cls, path: str, capacity: int, key: str) -> "Channel":
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o600)
+        os.ftruncate(fd, HDR + capacity)
+        mm = mmap.mmap(fd, HDR + capacity)
+        ch = cls(path, mm, fd, key)
+        for w in (_W_FLAGS, _W_VERSION, _W_ACK, _W_LEN, _W_FFLAGS,
+                  _W_WCLOCK, _W_RCLOCK):
+            ch._put(w, 0)
+        ch._put(_W_CAP, capacity)
+        ch._put(_W_MAGIC, MAGIC)  # last: publishes the header to readers
+        return ch
+
+    @classmethod
+    def open_wait(cls, path: str, key: str, timeout: float = 30.0,
+                  should_stop: Optional[Callable[[], bool]] = None) -> "Channel":
+        """Attach to a channel another process creates; polls for the file
+        and its magic word up to ``timeout``."""
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                fd = os.open(path, os.O_RDWR)
+            except FileNotFoundError:
+                fd = -1
+            if fd >= 0:
+                size = os.fstat(fd).st_size
+                if size >= HDR:
+                    mm = mmap.mmap(fd, size)
+                    ch = cls(path, mm, fd, key)
+                    if ch._get(_W_MAGIC) == MAGIC:
+                        return ch
+                    ch._mm = None
+                    mm.close()
+                os.close(fd)
+            if should_stop is not None and should_stop():
+                raise ChannelClosedError(f"channel {key} never appeared "
+                                         "(stage stopping)")
+            if time.monotonic() >= deadline:
+                raise ChannelTimeoutError(
+                    f"channel {key} did not appear at {path} "
+                    f"within {timeout:.0f}s"
+                )
+            time.sleep(0.002)
+
+    def close(self, error: bool = False) -> None:
+        """Set the CLOSED (and optionally ERROR) flag, waking both ends.
+        Idempotent; the mapping stays valid for a draining peer."""
+        if self._mm is None:
+            return
+        flags = self._get(_W_FLAGS) | FLAG_CLOSED | (FLAG_ERROR if error else 0)
+        self._put(_W_FLAGS, flags)
+
+    def detach(self) -> None:
+        """Drop this end's mapping (does NOT unlink the file)."""
+        self._closed_local = True
+        mm, self._mm = self._mm, None
+        if mm is not None:
+            try:
+                mm.close()
+            except BufferError:
+                pass  # an exported view is still alive; leak the map
+        if self._fd >= 0:
+            os.close(self._fd)
+            self._fd = -1
+
+    @staticmethod
+    def unlink(path: str) -> None:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+    # ------------------------------------------------------------ low-level
+
+    def _get(self, word: int) -> int:
+        return _U64.unpack_from(self._mm, word * 8)[0]
+
+    def _put(self, word: int, value: int) -> None:
+        _U64.pack_into(self._mm, word * 8, value)
+
+    @property
+    def closed(self) -> bool:
+        return bool(self._get(_W_FLAGS) & FLAG_CLOSED)
+
+    @property
+    def errored(self) -> bool:
+        return bool(self._get(_W_FLAGS) & FLAG_ERROR)
+
+    def _raise_closed(self) -> None:
+        if self.errored:
+            raise ChannelClosedError(
+                f"channel {self.key}: peer died (stage worker or node lost)"
+            )
+        raise ChannelClosedError(f"channel {self.key} is closed")
+
+    def _remap(self) -> None:
+        size = os.fstat(self._fd).st_size
+        if size > len(self._mm):
+            old, self._mm = self._mm, mmap.mmap(self._fd, size)
+            try:
+                old.close()
+            except BufferError:
+                pass
+
+    def _park(self, spins: int) -> None:
+        # adaptive wait: stay hot for the first ~1k polls (same-host
+        # hand-off is microseconds), then yield the core
+        if spins < 1000:
+            time.sleep(0)
+        else:
+            time.sleep(0.0002 if spins < 5000 else 0.002)
+
+    # ------------------------------------------------------------ data path
+
+    def write(self, payload: bytes, timeout: Optional[float] = 60.0,
+              should_stop: Optional[Callable[[], bool]] = None) -> int:
+        """Commit one frame; blocks until the reader consumed the previous
+        one (backpressure). Returns the committed seq."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        spins = 0
+        while True:
+            if self._get(_W_FLAGS) & (FLAG_CLOSED | FLAG_ERROR):
+                self._raise_closed()
+            version = self._get(_W_VERSION)
+            if self._get(_W_ACK) == version:
+                break
+            if should_stop is not None and should_stop():
+                raise ChannelClosedError(f"channel {self.key}: stage stopping")
+            if deadline is not None and time.monotonic() >= deadline:
+                raise ChannelTimeoutError(
+                    f"write on {self.key} timed out waiting for reader ack "
+                    f"(seq {version} unconsumed)"
+                )
+            self._park(spins)
+            spins += 1
+        need = len(payload)
+        if need > self._get(_W_CAP):
+            new_cap = max(need, 2 * self._get(_W_CAP))
+            os.ftruncate(self._fd, HDR + new_cap)
+            self._remap()
+            self._put(_W_CAP, new_cap)
+        self._mm[HDR:HDR + need] = payload
+        self._put(_W_LEN, need)
+        seq = version + 1
+        t = _tracer()
+        if t is not None:
+            t.merge_clock(self._get(_W_RCLOCK))
+            self._put(_W_WCLOCK, t.apply("chan_write", chan=self.key, seq=seq))
+        self._put(_W_VERSION, seq)  # commit: readers wake on this word
+        return seq
+
+    def read(self, timeout: Optional[float] = 60.0,
+             should_stop: Optional[Callable[[], bool]] = None,
+             ) -> Tuple[int, bytes]:
+        """Consume the next frame; blocks until the writer commits one.
+        Returns ``(seq, payload)``."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        spins = 0
+        while True:
+            if self._get(_W_FLAGS) & FLAG_ERROR:
+                self._raise_closed()
+            ack = self._get(_W_ACK)
+            version = self._get(_W_VERSION)
+            if version > ack:
+                break
+            if self._get(_W_FLAGS) & FLAG_CLOSED:
+                self._raise_closed()  # closed AND drained
+            if should_stop is not None and should_stop():
+                raise ChannelClosedError(f"channel {self.key}: stage stopping")
+            if deadline is not None and time.monotonic() >= deadline:
+                raise ChannelTimeoutError(
+                    f"read on {self.key} timed out at seq {ack}"
+                )
+            self._park(spins)
+            spins += 1
+        need = self._get(_W_LEN)
+        if HDR + need > len(self._mm):
+            self._remap()  # writer grew the file under us
+        payload = bytes(self._mm[HDR:HDR + need])
+        seq = version
+        t = _tracer()
+        if t is not None:
+            t.merge_clock(self._get(_W_WCLOCK))
+            self._put(_W_RCLOCK, t.apply("chan_read", chan=self.key, seq=seq))
+        self._put(_W_ACK, seq)  # frees the writer's next frame
+        return seq, payload
+
+
+def poke_error(path: str) -> bool:
+    """Flag an existing channel file CLOSED|ERROR without attaching a full
+    end — used by the daemon to wake every parked reader/writer of a DAG
+    whose pinned worker just died. Returns False when the file is absent
+    (channel never created — nothing parked on it)."""
+    try:
+        fd = os.open(path, os.O_RDWR)
+    except OSError:
+        return False
+    try:
+        if os.fstat(fd).st_size < HDR:
+            return False
+        mm = mmap.mmap(fd, HDR)
+        flags = _U64.unpack_from(mm, _W_FLAGS * 8)[0]
+        _U64.pack_into(mm, _W_FLAGS * 8, flags | FLAG_CLOSED | FLAG_ERROR)
+        mm.close()
+        return True
+    finally:
+        os.close(fd)

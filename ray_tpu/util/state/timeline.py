@@ -23,10 +23,15 @@ def chrome_trace(events: Optional[List[dict]] = None) -> List[dict]:
         end = e.get("end")
         if start is None or end is None:
             continue
-        lane = e.get("actor_id") or e.get("worker_id") or "tasks"
+        # compiled-DAG iteration spans (gcs rpc_dag_spans) carry a "stage"
+        # lane so the hot loop renders as per-stage occupancy rows instead
+        # of disappearing into one "tasks" lane
+        lane = (e.get("actor_id") or e.get("stage") or e.get("worker_id")
+                or "tasks")
         trace.append({
             "name": e.get("name") or e.get("task_id", "task"),
-            "cat": "actor_task" if e.get("actor_id") else "task",
+            "cat": "dag_stage" if e.get("stage")
+            else "actor_task" if e.get("actor_id") else "task",
             "ph": "X",
             "ts": start * 1e6,  # chrome trace wants microseconds
             "dur": max((end - start) * 1e6, 1.0),
