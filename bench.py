@@ -713,6 +713,51 @@ print(json.dumps({
     return json.loads(r.stdout.strip().splitlines()[-1])
 
 
+def explore_bench(budget=1400, samples=800):
+    """Schedules/second through the deterministic control-plane model
+    checker (analysis/explore.py): every schedule is a fresh GcsServer
+    world executed end to end and invariant-checked. Also reports DFS
+    branches pruned by the persistent-set filter vs branches queued, and
+    handler-pair interleaving coverage. Run: `python bench.py explore`
+    (recorded as BENCH_explore_rNN.json)."""
+    import time as _t
+
+    from ray_tpu.analysis import explore as _explore
+
+    per = {}
+    t0 = _t.perf_counter()
+    total = pruned = queued = 0
+    coverage = set()
+    for name in sorted(_explore.SCENARIOS):
+        r = _explore.explore(
+            _explore.SCENARIOS[name], max_schedules=budget,
+            samples=samples,
+        )
+        assert not r.found, (name, r.violating and r.violating.violations)
+        per[name] = {
+            "schedules": r.schedules_run,
+            "pruned": r.branches_pruned,
+            "queued": r.branches_queued,
+            "coverage_pairs": len(r.coverage),
+            "elapsed_s": round(r.elapsed_s, 3),
+            "schedules_per_sec": round(r.schedules_run / r.elapsed_s, 1),
+        }
+        total += r.schedules_run
+        pruned += r.branches_pruned
+        queued += r.branches_queued
+        coverage |= r.coverage
+    elapsed = _t.perf_counter() - t0
+    return {
+        "schedules": total,
+        "schedules_per_sec": round(total / elapsed, 1),
+        "branches_pruned": pruned,
+        "branches_queued": queued,
+        "coverage_pairs": len(coverage),
+        "elapsed_s": round(elapsed, 2),
+        "scenarios": per,
+    }
+
+
 def dag_loop_bench(n_stages=3, iters=300, remote_iters=40):
     """Compiled-graph hot loop vs the equivalent `.remote()` chain on a
     3-stage local-cluster pipeline (the ISSUE-4 acceptance metric): the
@@ -799,6 +844,20 @@ def _tpu_available(timeout_s: float = 120.0) -> bool:
 def main():
     global ALGO
     import os
+
+    if sys.argv[1:] == ["explore"]:
+        # standalone model-checker microbench: no TPU probe (pure host
+        # python) — prints one JSON line (recorded as BENCH_explore_rNN)
+        r = explore_bench()
+        log(f"explore {r['schedules']} schedules in {r['elapsed_s']}s")
+        print(json.dumps({
+            "metric": "explore_schedules_per_sec",
+            "value": r["schedules_per_sec"],
+            "unit": "schedules/s (full scenario library, fresh world "
+                    "per schedule, invariant-checked)",
+            "configs": {"explore": r},
+        }))
+        return
 
     if sys.argv[1:] == ["dag_loop"]:
         # standalone compiled-graph microbench: no TPU probe, no kernel

@@ -6,9 +6,12 @@ unsafe-closure-capture, lock-order-cycle, unawaited-coroutine,
 dropped-object-ref, resource-spec-validation, unbounded-rpc-call, plus
 the protocol checkers over :mod:`ray_tpu.analysis.protocol`'s extracted
 RPC model: rpc-method-unknown, rpc-payload-key-mismatch,
-push-topic-unknown, config-key-unknown) with per-line
-``# ray-lint: disable=<check>`` pragmas and a committed ratchet
-baseline. ``--dump-protocol`` emits the protocol model as JSON.
+push-topic-unknown, config-key-unknown, and the lifecycle checkers over
+:mod:`ray_tpu.analysis.statemachine`'s declared/extracted state
+machines: illegal-state-transition, cross-thread-field-write) with
+per-line ``# ray-lint: disable=<check>`` pragmas and a committed
+ratchet baseline. ``--dump-protocol`` emits the protocol model
+(including the state machines) as JSON.
 
 Runtime half: :class:`ray_tpu.analysis.sanitizer.LockOrderSanitizer`
 (instrumented-lock shim cross-checking the static lock graph via the
@@ -16,6 +19,12 @@ Runtime half: :class:`ray_tpu.analysis.sanitizer.LockOrderSanitizer`
 (Lamport-clocked protocol tracer + offline happens-before invariant
 checker, ``invariant_sanitizer`` fixture / ``--check-trace``) — each
 runtime sanitizer is the dynamic cross-check of its static model.
+
+Model-checking half: :mod:`ray_tpu.analysis.explore` runs the real GCS
+handler object under a virtual runtime and *searches* handler
+interleavings (bounded DFS + pruning + seeded sampling), replaying each
+schedule through the invariant checker; ``--explore`` / ``--replay`` on
+the CLI, budgeted in CI via ``scripts/lint_gate.py --explore``.
 
 Deliberately imports no runtime module (jax, numpy, the cluster stack):
 linting must work in any environment the source parses in.

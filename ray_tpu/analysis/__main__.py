@@ -45,6 +45,34 @@ def main(argv=None) -> int:
                         "(JSONL from the invariant sanitizer) and verify "
                         "the happens-before invariants; exit 1 on "
                         "violations")
+    p.add_argument("--explore", default=None, metavar="SCENARIO",
+                   nargs="?", const="all",
+                   help="instead of linting, model-check the control "
+                        "plane: explore handler interleavings of one "
+                        "scenario (or 'all') through the invariant "
+                        "checker; exit 1 on any violation")
+    p.add_argument("--list-scenarios", action="store_true")
+    p.add_argument("--budget", type=int, default=500,
+                   help="DFS schedule budget per scenario (default 500)")
+    p.add_argument("--samples", type=int, default=200,
+                   help="seeded-random schedules beyond the DFS bound "
+                        "(default 200)")
+    p.add_argument("--depth", type=int, default=30,
+                   help="DFS branch-depth bound (default 30)")
+    p.add_argument("--seed", type=int, default=0,
+                   help="random-sampling seed (same seed = byte-"
+                        "identical exploration)")
+    p.add_argument("--wall-cap", type=float, default=None, metavar="S",
+                   help="wall-clock cap in seconds per scenario")
+    p.add_argument("--seed-bug", action="append", default=[],
+                   metavar="NAME",
+                   help="re-introduce a known fixed bug (gcs.SEEDED_BUGS) "
+                        "for the exploration — the regression harness")
+    p.add_argument("--save-replay", default=None, metavar="FILE",
+                   help="write the first (shrunk) counterexample here")
+    p.add_argument("--replay", default=None, metavar="FILE",
+                   help="re-execute a recorded counterexample "
+                        "deterministically; exit 1 if it still violates")
     args = p.parse_args(argv)
 
     # Import for side effect: populate the registry before --list-checks.
@@ -54,6 +82,69 @@ def main(argv=None) -> int:
         for name in sorted(CHECKERS):
             print(f"{name}: {CHECKERS[name].description}")
         return 0
+
+    if args.list_scenarios:
+        from ray_tpu.analysis.explore import SCENARIOS
+
+        for name in sorted(SCENARIOS):
+            print(f"{name}: {SCENARIOS[name].description}")
+        return 0
+
+    if args.replay is not None:
+        from ray_tpu.analysis import explore as _explore
+
+        try:
+            res = _explore.replay(args.replay)
+        except (OSError, ValueError, KeyError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        print(f"replayed {len(res.schedule)} steps of {res.scenario}:")
+        for step in res.schedule:
+            print(f"  {step}")
+        for v in res.violations:
+            print(v.format())
+        print(f"{len(res.violations)} violation(s)")
+        return 1 if res.violations else 0
+
+    if args.explore is not None:
+        from ray_tpu.analysis import explore as _explore
+
+        names = (
+            sorted(_explore.SCENARIOS) if args.explore == "all"
+            else [args.explore]
+        )
+        unknown = [n for n in names if n not in _explore.SCENARIOS]
+        if unknown:
+            print(f"error: unknown scenario(s) {unknown}; have "
+                  f"{sorted(_explore.SCENARIOS)}", file=sys.stderr)
+            return 2
+        failed = False
+        for name in names:
+            res = _explore.explore(
+                _explore.SCENARIOS[name],
+                max_schedules=args.budget,
+                samples=args.samples,
+                max_depth=args.depth,
+                seed=args.seed,
+                seeded_bugs=args.seed_bug,
+                wall_cap_s=args.wall_cap,
+            )
+            print(res.summary())
+            if res.found:
+                failed = True
+                for v in (res.shrunk_violations
+                          or res.violating.violations):
+                    print("  " + v.format())
+                print("  minimal schedule:")
+                for step in (res.shrunk or res.violating.schedule):
+                    print(f"    {step}")
+                if args.save_replay:
+                    _explore.write_replay(
+                        args.save_replay, res, seeded_bugs=args.seed_bug
+                    )
+                    print(f"  replay written to {args.save_replay} "
+                          "(re-run with --replay)")
+        return 1 if failed else 0
 
     if args.check_trace is not None:
         from ray_tpu.analysis.invariants import check_trace

@@ -1282,6 +1282,330 @@ class ConfigKeyUnknownChecker(_ProtocolCheckerBase):
         return out
 
 
+# --------------------------------------------- lifecycle / thread checkers
+
+
+@register
+class IllegalStateTransitionChecker(Checker):
+    name = "illegal-state-transition"
+    description = (
+        "a GCS/daemon handler writes an entity lifecycle state the "
+        "declared state machine (analysis/statemachine.py) does not "
+        "allow: an unknown state string (typo), a row created in a "
+        "non-initial state, a state no declared edge produces, or a "
+        "guarded write out of an observed state with no such edge"
+    )
+
+    def check_module(self, ctx: ModuleContext) -> List[Finding]:
+        from ray_tpu.analysis import statemachine as sm
+
+        if not sm.applies_to(ctx):
+            return []
+        out: List[Finding] = []
+        for w, problem in sm.check_writes(sm.extract_module(ctx)):
+            out.append(Finding(
+                path=w.path, line=w.line, col=0, check=self.name,
+                message=f"{problem} (in {w.func}); declare the edge in "
+                        "statemachine.MACHINES if the protocol really "
+                        "grew, or fix the write",
+                line_text=w.line_text, end_line=w.end_line,
+            ))
+        return out
+
+
+#: attribute-call names that mutate a container in place
+_MUTATOR_ATTRS = frozenset({
+    "append", "appendleft", "add", "pop", "popleft", "popitem", "remove",
+    "discard", "clear", "update", "setdefault", "extend", "insert",
+    "move_to_end",
+})
+
+#: constructors whose result is a shared mutable container
+_CONTAINER_CTORS = frozenset({
+    "dict", "list", "set", "deque", "defaultdict", "OrderedDict",
+})
+
+
+@register
+class CrossThreadFieldWriteChecker(Checker):
+    name = "cross-thread-field-write"
+    description = (
+        "a GCS/daemon mutable container field is written from two "
+        "different execution contexts (rpc-handler loop, push-subscriber "
+        "thread, background thread, executor) with at least one write "
+        "not under a class lock: read-modify-write races the GIL does "
+        "not serialize"
+    )
+
+    #: execution-context roots by method-name shape
+    _THREAD_SUFFIX = "_loop"
+
+    def check_module(self, ctx: ModuleContext) -> List[Finding]:
+        from ray_tpu.analysis import statemachine as sm
+
+        if not sm.applies_to(ctx):
+            return []
+        out: List[Finding] = []
+        for cls in ast.walk(ctx.tree):
+            if isinstance(cls, ast.ClassDef):
+                out.extend(self._check_class(ctx, cls))
+        return out
+
+    # ------------------------------------------------------ class model
+
+    def _check_class(self, ctx: ModuleContext,
+                     cls: ast.ClassDef) -> List[Finding]:
+        methods = {
+            n.name: n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        lock_attrs = self._lock_attrs(cls)
+        fields = self._mutable_fields(methods.get("__init__"))
+        if not fields:
+            return []
+        roots = self._context_roots(cls, methods)
+        if len({c for _m, c in roots}) < 2:
+            return []  # single execution context: nothing can race
+        # effective context/locked per method, propagated through the
+        # same-class call graph (a helper called only under the lock
+        # inherits lock-held-ness; the _locked suffix asserts it)
+        reach: Dict[str, Set[Tuple[str, bool]]] = {}
+        work = [(m, c, False) for m, c in roots if m in methods]
+        while work:
+            name, context, locked = work.pop()
+            eff_locked = locked or name.endswith("_locked")
+            key = (context, eff_locked)
+            if key in reach.setdefault(name, set()):
+                continue
+            reach[name].add(key)
+            for callee, call_locked in self._calls_of(
+                methods[name], lock_attrs
+            ):
+                if callee in methods:
+                    work.append((callee, context, eff_locked or call_locked))
+        # collect mutations: field -> [(context, locked, node, method)]
+        mutations: Dict[str, List[Tuple[str, bool, ast.AST, str]]] = {}
+        for name, fn in methods.items():
+            if name == "__init__":
+                continue
+            for context, locked in reach.get(name, ()):
+                for field, node, in_with in self._mutations(fn, fields,
+                                                            lock_attrs):
+                    mutations.setdefault(field, []).append(
+                        (context, locked or in_with, node, name)
+                    )
+        out: List[Finding] = []
+        flagged: Set[int] = set()
+        for field, muts in mutations.items():
+            contexts = {c for c, _l, _n, _m in muts}
+            if len(contexts) < 2:
+                continue
+            if all(locked for _c, locked, _n, _m in muts):
+                continue
+            for context, locked, node, mname in muts:
+                if locked or id(node) in flagged:
+                    continue
+                flagged.add(id(node))
+                others = sorted(contexts - {context}) or sorted(contexts)
+                out.append(ctx.finding(
+                    node, self.name,
+                    f"`self.{field}` is mutated here on the {context} "
+                    f"context without holding a class lock, and also "
+                    f"from {', '.join(others)} — wrap both in `with "
+                    f"self.{sorted(lock_attrs)[0] if lock_attrs else '_lock'}"
+                    "`, or suppress with `# ray-lint: "
+                    "disable=cross-thread-field-write` if the field is "
+                    "provably confined",
+                ))
+        return out
+
+    # ------------------------------------------------------- extraction
+
+    @staticmethod
+    def _lock_attrs(cls: ast.ClassDef) -> Set[str]:
+        out: Set[str] = set()
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Assign):
+                continue
+            v = node.value
+            if isinstance(v, ast.Call) and isinstance(v.func, ast.Attribute) \
+                    and v.func.attr in ("Lock", "RLock", "Condition"):
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) and isinstance(
+                        t.value, ast.Name
+                    ) and t.value.id == "self":
+                        out.add(t.attr)
+        return out
+
+    @staticmethod
+    def _mutable_fields(init) -> Set[str]:
+        if init is None:
+            return set()
+        out: Set[str] = set()
+        for node in ast.walk(init):
+            if isinstance(node, ast.Assign):
+                targets, v = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, v = [node.target], node.value
+            else:
+                continue
+            is_container = isinstance(v, (ast.Dict, ast.List, ast.Set)) or (
+                isinstance(v, ast.Call) and (
+                    (isinstance(v.func, ast.Name)
+                     and v.func.id in _CONTAINER_CTORS)
+                    or (isinstance(v.func, ast.Attribute)
+                        and v.func.attr in _CONTAINER_CTORS)
+                )
+            )
+            if not is_container:
+                continue
+            for t in targets:
+                if isinstance(t, ast.Attribute) and isinstance(
+                    t.value, ast.Name
+                ) and t.value.id == "self":
+                    out.add(t.attr)
+        return out
+
+    def _context_roots(self, cls: ast.ClassDef,
+                       methods) -> List[Tuple[str, str]]:
+        """(method, context) execution entry points."""
+        roots: List[Tuple[str, str]] = []
+        for name in methods:
+            if name.startswith("rpc_"):
+                roots.append((name, "rpc-handler loop"))
+            elif name.endswith(self._THREAD_SUFFIX):
+                roots.append((name, "background thread"))
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            attr = f.attr if isinstance(f, ast.Attribute) else None
+            # .subscribe("topic", self._on_x) -> client dispatch thread
+            if attr == "subscribe" and len(node.args) > 1:
+                m = self._self_method(node.args[1])
+                if m:
+                    roots.append((m, "push-subscriber thread"))
+            # Thread(target=self._x)
+            if attr == "Thread" or (isinstance(f, ast.Name)
+                                    and f.id == "Thread"):
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        m = self._self_method(kw.value)
+                        if m:
+                            roots.append((m, "background thread"))
+            # run_in_executor(None, self._x | lambda: self._x(...))
+            if attr == "run_in_executor" and len(node.args) > 1:
+                m = self._self_method(node.args[1])
+                if m:
+                    roots.append((m, "executor"))
+            # on_disconnect=self._x runs on the server loop
+            for kw in node.keywords:
+                if kw.arg == "on_disconnect":
+                    m = self._self_method(kw.value)
+                    if m:
+                        roots.append((m, "rpc-handler loop"))
+        return roots
+
+    @staticmethod
+    def _self_method(expr: ast.AST) -> Optional[str]:
+        if isinstance(expr, ast.Attribute) and isinstance(
+            expr.value, ast.Name
+        ) and expr.value.id == "self":
+            return expr.attr
+        if isinstance(expr, ast.Lambda):
+            for sub in ast.walk(expr.body):
+                if isinstance(sub, ast.Call) and isinstance(
+                    sub.func, ast.Attribute
+                ) and isinstance(sub.func.value, ast.Name) \
+                        and sub.func.value.id == "self":
+                    return sub.func.attr
+        return None
+
+    def _calls_of(self, fn, lock_attrs) -> List[Tuple[str, bool]]:
+        """Same-class ``self.m()`` calls with their lock-held-ness."""
+        out: List[Tuple[str, bool]] = []
+        locked_ids = self._nodes_under_lock(fn, lock_attrs)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute
+            ) and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id == "self":
+                out.append((node.func.attr, id(node) in locked_ids))
+        return out
+
+    @staticmethod
+    def _nodes_under_lock(fn, lock_attrs) -> Set[int]:
+        """ids of AST nodes lexically inside `with self.<lock>:`."""
+        out: Set[int] = set()
+
+        def is_lock_with(w: ast.AST) -> bool:
+            if not isinstance(w, ast.With):
+                return False
+            for item in w.items:
+                e = item.context_expr
+                if isinstance(e, ast.Attribute) and isinstance(
+                    e.value, ast.Name
+                ) and e.value.id == "self" and e.attr in lock_attrs:
+                    return True
+            return False
+
+        def walk(node, locked):
+            for child in ast.iter_child_nodes(node):
+                child_locked = locked or is_lock_with(child)
+                if child_locked:
+                    out.add(id(child))
+                    for sub in ast.walk(child):
+                        out.add(id(sub))
+                else:
+                    walk(child, child_locked)
+
+        walk(fn, False)
+        return out
+
+    def _mutations(self, fn, fields: Set[str],
+                   lock_attrs: Set[str]) -> List[Tuple[str, ast.AST, bool]]:
+        """(field, node, under_with_lock) mutation sites of tracked
+        fields inside one method."""
+        locked_ids = self._nodes_under_lock(fn, lock_attrs)
+        out: List[Tuple[str, ast.AST, bool]] = []
+
+        def self_field(expr) -> Optional[str]:
+            if isinstance(expr, ast.Attribute) and isinstance(
+                expr.value, ast.Name
+            ) and expr.value.id == "self" and expr.attr in fields:
+                return expr.attr
+            return None
+
+        for node in ast.walk(fn):
+            field = None
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (
+                    node.targets if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in targets:
+                    # self.F = ... (rebind) or self.F[k] = ...
+                    field = self_field(t) or (
+                        self_field(t.value)
+                        if isinstance(t, ast.Subscript) else None
+                    )
+                    if field:
+                        break
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript):
+                        field = self_field(t.value)
+                        if field:
+                            break
+            elif isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute
+            ) and node.func.attr in _MUTATOR_ATTRS:
+                field = self_field(node.func.value)
+            if field:
+                out.append((field, node, id(node) in locked_ids))
+        return out
+
+
 def static_lock_graph(paths, root=None):
     """The lock-order checker's accumulated graph for the given paths:
     ({node: {kind, where}}, {(src, dst): (path, line)}). Used by tests to

@@ -33,8 +33,10 @@ HANDLER_PREFIX = "rpc_"
 CALL_ATTRS = ("call", "call_async", "notify")
 
 #: (attribute name -> positional index of the topic argument) for
-#: server->client pushes; wrappers in gcs.py take the topic second
-PUSH_ATTRS = {"push": 0, "broadcast": 0, "_push_conn": 1, "_push_to_node": 1}
+#: server->client pushes; wrappers in gcs.py and the RpcServer.send_push
+#: seam take the topic second
+PUSH_ATTRS = {"push": 0, "broadcast": 0, "_push_conn": 1,
+              "_push_to_node": 1, "send_push": 1}
 
 #: env literals like RAY_TPU_scheduling_policy are config knobs; the
 #: all-caps infra vars (RAY_TPU_CHAOS_SPEC, RAY_TPU_WORKER_ID, ...) are not
@@ -151,14 +153,20 @@ class ProtocolIndex:
         self.config_keys: Set[str] = set()
         self.config_defs_path: Optional[str] = None
         self.config_uses: List[ConfigUse] = []
+        # per-entity lifecycle writes (analysis/statemachine.py): the
+        # extracted counterpart of the declared MACHINES table
+        self.state_writes: List = []
 
     # ------------------------------------------------------------ building
 
     def add_module(self, ctx: ModuleContext) -> None:
+        from ray_tpu.analysis import statemachine as _sm
+
         self._collect_handlers(ctx)
         self._collect_wire_sites(ctx)
         self._collect_config_defs(ctx)
         self._collect_config_uses(ctx)
+        self.state_writes.extend(_sm.extract_module(ctx))
 
     @classmethod
     def piece_for(cls, ctx: ModuleContext) -> "ProtocolIndex":
@@ -183,6 +191,7 @@ class ProtocolIndex:
         if other.config_defs_path is not None:
             self.config_defs_path = other.config_defs_path
         self.config_uses.extend(other.config_uses)
+        self.state_writes.extend(other.state_writes)
 
     def _collect_handlers(self, ctx: ModuleContext) -> None:
         server = _server_label(ctx.relpath)
@@ -489,7 +498,20 @@ class ProtocolIndex:
                 "defs_path": self.config_defs_path,
                 "uses": [u.to_dict() for u in self.config_uses],
             },
+            "statemachines": {
+                "declared": {
+                    name: m.to_dict()
+                    for name, m in sorted(_machines().items())
+                },
+                "writes": [w.to_dict() for w in self.state_writes],
+            },
         }
+
+
+def _machines():
+    from ray_tpu.analysis.statemachine import MACHINES
+
+    return MACHINES
 
 
 def extract_protocol(paths, root=None) -> ProtocolIndex:

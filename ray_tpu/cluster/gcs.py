@@ -25,16 +25,28 @@ import numpy as np
 from ray_tpu.core.config import Config
 from ray_tpu.cluster import rpc as rpc_mod
 from ray_tpu.cluster.rpc import RpcClient, RpcServer
+from ray_tpu.cluster.runtime import ThreadRuntime
 from ray_tpu.sched.policy import make_policy_from_config
 from ray_tpu.sched.resources import NodeResourceState, ResourceSpace
 from ray_tpu.sched import bundles as bundles_mod
 from ray_tpu.util.task_events import TaskEventLog
 
+# TEST-ONLY regression switchboard for the deterministic explorer
+# (ray_tpu/analysis/explore.py): names added here re-introduce known,
+# FIXED control-plane bugs so the explorer's seeded-bug harness can prove
+# it still finds them. Empty in production; never consulted on a hot path
+# beyond a set-membership test inside the affected handler.
+SEEDED_BUGS: set = set()
+
 
 class GcsServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  config: Optional[Config] = None,
-                 persistence_path: Optional[str] = None):
+                 persistence_path: Optional[str] = None,
+                 runtime=None):
+        # execution seam (threads/sockets vs the explorer's virtual
+        # clock + controlled event queue) — see cluster/runtime.py
+        self._rt = runtime or ThreadRuntime()
         self.config = config or Config()
         self.space = ResourceSpace()
         self.state = NodeResourceState(space=self.space)
@@ -67,6 +79,15 @@ class GcsServer:
         # the running-table pop, the EVENT log dedupes here). Keyed by the
         # full report identity — a genuine re-execution has new timestamps.
         self._taskdone_seen: OrderedDict = OrderedDict()
+        # free tombstones: an owner's free must win against location
+        # reports still in flight (a producer's FIRST task_done landing
+        # after the free used to re-insert the location — and since the
+        # free saw an empty directory, no free_objects push ever reached
+        # the node: a permanent store leak + ghost directory entry.
+        # Found by the interleaving explorer, scenario watchdog-resend).
+        # Late reports of a tombstoned oid get the free completed on the
+        # reporting node instead of a directory add. Bounded LRU.
+        self._freed_tombstones: OrderedDict = OrderedDict()
         # borrow registry (reference: reference_count.cc borrower sets): the
         # owner defers frees while a borrow exists; records here exist so a
         # dead NODE's borrows can be released on its behalf (a dead worker's
@@ -123,7 +144,7 @@ class GcsServer:
         # in O(1) instead of scanning every queue)
         self.active_outputs: Dict[str, int] = defaultdict(int)
 
-        self.server = RpcServer(
+        self.server = self._rt.make_server(
             self._handle, host=host, port=port,
             on_disconnect=self._on_disconnect, name="gcs",
         )
@@ -131,19 +152,20 @@ class GcsServer:
         self.addr = (host, self.port)
         self._stopped = False
         self._sched_cv = threading.Condition()
-        self._sched_thread = threading.Thread(
-            target=self._sched_loop, daemon=True, name="gcs-sched"
-        )
-        self._sched_thread.start()
-        self._health_thread = threading.Thread(
-            target=self._health_loop, daemon=True, name="gcs-health"
-        )
-        self._health_thread.start()
-        if self.persistence_path:
-            self._persist_thread = threading.Thread(
-                target=self._persist_loop, daemon=True, name="gcs-persist"
+        if self._rt.threaded:
+            self._sched_thread = threading.Thread(
+                target=self._sched_loop, daemon=True, name="gcs-sched"
             )
-            self._persist_thread.start()
+            self._sched_thread.start()
+            self._health_thread = threading.Thread(
+                target=self._health_loop, daemon=True, name="gcs-health"
+            )
+            self._health_thread.start()
+            if self.persistence_path:
+                self._persist_thread = threading.Thread(
+                    target=self._persist_loop, daemon=True, name="gcs-persist"
+                )
+                self._persist_thread.start()
 
     # ------------------------------------------------------- persistence
 
@@ -255,6 +277,10 @@ class GcsServer:
         from ray_tpu.util.events import record_event
 
         with self._lock:
+            if getattr(conn, "closed", False):
+                # see rpc_register_driver: a dispatch task outliving its
+                # connection must not resurrect the node row
+                return {"ok": False, "error": "connection closed"}
             node_id = p["node_id"]
             prev = self.nodes.get(node_id)
             rejoin = prev is not None
@@ -271,9 +297,17 @@ class GcsServer:
                 and p.get("instance") is not None
                 and prev.get("instance") != p.get("instance")
             ):
-                self._mark_node_dead(
-                    node_id, "superseded by a new daemon instance"
-                )
+                if "register-node-double-book" in SEEDED_BUGS:
+                    # SEEDED BUG (test-only; see SEEDED_BUGS above):
+                    # PR 3's capacity double-booking — reset the live
+                    # row's availability while running tasks still hold
+                    # debits, instead of death-sweeping first. The
+                    # explorer's regression harness must find this.
+                    self.state.revive_node(node_id, p["resources"])
+                else:
+                    self._mark_node_dead(
+                        node_id, "superseded by a new daemon instance"
+                    )
             self.nodes[node_id] = {
                 "node_id": node_id,
                 "addr": p["addr"],
@@ -281,7 +315,7 @@ class GcsServer:
                 "resources": p["resources"],
                 "alive": True,
                 "conn_id": conn.conn_id,
-                "last_beat": time.time(),
+                "last_beat": self._rt.now(),
                 "labels": p.get("labels", {}),
                 "shm_name": p.get("shm_name"),
                 "instance": p.get("instance"),
@@ -325,6 +359,11 @@ class GcsServer:
         actors and stored objects (reference: raylet re-registration +
         ownership re-publish after GCS FT restart)."""
         with self._lock:
+            if getattr(conn, "closed", False):
+                # see rpc_register_driver: a dispatch task outliving its
+                # connection must not resurrect locations/actor rows for
+                # a node whose death sweep already ran
+                return {"ok": False, "error": "connection closed"}
             node_id = p["node_id"]
             for actor_id in p.get("actor_ids", []):
                 a = self.actors.get(actor_id)
@@ -337,13 +376,19 @@ class GcsServer:
                 else:
                     a["node_id"] = node_id
                     a["state"] = "ALIVE"
+            resync_frees: List[str] = []
             for oid in p.get("object_ids", []):
-                self.directory[oid].add(node_id)
+                if not self._add_location_locked(oid, node_id):
+                    resync_frees.append(oid)
+                    continue
                 self._on_object_added(oid)
                 if rpc_mod.TRACE is not None:
                     rpc_mod.TRACE.apply(
                         "obj_loc", oid=oid, node=node_id, resync=True
                     )
+        if resync_frees:
+            self._push_to_node(node_id, "free_objects",
+                               {"object_ids": resync_frees})
         self._kick()
         return {"ok": True}
 
@@ -351,7 +396,7 @@ class GcsServer:
         with self._lock:
             n = self.nodes.get(p["node_id"])
             if n:
-                n["last_beat"] = time.time()
+                n["last_beat"] = self._rt.now()
                 if p.get("stats"):
                     # per-node physical stats (reporter-agent analog);
                     # served through get_nodes / the dashboard node table
@@ -369,6 +414,13 @@ class GcsServer:
 
     def rpc_register_driver(self, p, conn):
         with self._lock:
+            if getattr(conn, "closed", False):
+                # this conn's disconnect cleanup has already run (its
+                # dispatch task outlived the read loop): registering now
+                # would resurrect a presence entry nothing ever sweeps.
+                # Found by the interleaving explorer (scenario
+                # dag-register-vs-driver-disconnect).
+                return {"ok": False, "error": "connection closed"}
             # a reconnecting driver supersedes its old connection's entry
             # immediately (the old conn's disconnect may land later, or the
             # conn may be half-dead); stale entries would otherwise win the
@@ -385,7 +437,8 @@ class GcsServer:
             }
             conn.meta["driver_id"] = p["driver_id"]
             self.jobs[p["driver_id"]] = {
-                "job_id": p["driver_id"], "start": time.time(), "state": "RUNNING",
+                "job_id": p["driver_id"], "start": self._rt.now(),
+                "state": "RUNNING",
             }
         return {"ok": True, "nodes": self.rpc_get_nodes({}, conn)}
 
@@ -402,7 +455,7 @@ class GcsServer:
                 # dispatch's resource hold when the second overwrites it
                 return {"ok": True, "duplicate": True}
             p["owner_conn"] = conn.conn_id
-            p["enqueued_at"] = time.time()
+            p["enqueued_at"] = self._rt.now()
             if p.get("actor_creation"):
                 # keep the creation spec for restart-on-death (reference:
                 # gcs_actor_manager.cc retains the creation task spec)
@@ -455,11 +508,7 @@ class GcsServer:
                      + ",".join(d["id"][:8] for d in lost),
             "lost": lost,
         }
-        self.server.call_soon(
-            lambda t=target, pl=payload: __import__("asyncio").ensure_future(
-                t.push("task_result", pl)
-            )
-        )
+        self._push_conn(target, "task_result", payload)
 
     @staticmethod
     def _outputs_of(meta: dict) -> List[str]:
@@ -505,7 +554,7 @@ class GcsServer:
             return False
         if v is True:
             return True
-        return (time.time() - float(v)) < self.config.own_inflight_lease_s
+        return (self._rt.now() - float(v)) < self.config.own_inflight_lease_s
 
     def _missing_deps(self, t: dict) -> List[str]:
         """Dep object ids with no live location yet. Caller holds _lock."""
@@ -598,9 +647,14 @@ class GcsServer:
                             "release", key=p["task_id"],
                             node=info["node_id"],
                         )
+            stale_frees: List[str] = []
             if first_report:
                 for oid, size in p.get("results", []):
-                    self.directory[oid].add(p["node_id"])
+                    if not self._add_location_locked(oid, p["node_id"]):
+                        # owner freed this object while the report was in
+                        # flight: complete the free on the producing node
+                        stale_frees.append(oid)
+                        continue
                     self._on_object_added(oid)
                     if rpc_mod.TRACE is not None:
                         rpc_mod.TRACE.apply(
@@ -681,6 +735,10 @@ class GcsServer:
                             info.get("meta", {}).get("retries_left", 0) > 0
                         a["state"] = "PENDING" if retryable else "DEAD"
             target = self._driver_conn(owner_conn, owner_id)
+        if stale_frees:
+            self._push_to_node(
+                p["node_id"], "free_objects", {"object_ids": stale_frees}
+            )
         for t_conn, payload in cross_borrow_pushes:
             self._push_conn(t_conn, "borrow_added", payload)
         if kill_on_node is not None:
@@ -693,11 +751,7 @@ class GcsServer:
                 "actor_update", {"actor_id": alive_actor, "state": "ALIVE"}
             )
         if target is not None:
-            self.server.call_soon(
-                lambda: __import__("asyncio").ensure_future(
-                    target.push("task_result", p)
-                )
-            )
+            self._push_conn(target, "task_result", p)
         self._kick()
         return {"ok": True}
 
@@ -737,12 +791,15 @@ class GcsServer:
 
     def rpc_add_object_location(self, p, conn):
         with self._lock:
-            self.directory[p["object_id"]].add(p["node_id"])
-            ready = self._on_object_added(p["object_id"])
-            if rpc_mod.TRACE is not None:
+            added = self._add_location_locked(p["object_id"], p["node_id"])
+            ready = added and self._on_object_added(p["object_id"])
+            if added and rpc_mod.TRACE is not None:
                 rpc_mod.TRACE.apply(
                     "obj_loc", oid=p["object_id"], node=p["node_id"]
                 )
+        if not added:
+            self._push_to_node(p["node_id"], "free_objects",
+                               {"object_ids": [p["object_id"]]})
         if ready:
             self._kick()
         return {"ok": True}
@@ -864,12 +921,14 @@ class GcsServer:
         the owner (inline payload rides along for small items, so the
         driver needs no fetch round trip)."""
         with self._lock:
-            self.directory[p["object_id"]].add(p["node_id"])
-            ready = self._on_object_added(p["object_id"])
-            if rpc_mod.TRACE is not None:
-                rpc_mod.TRACE.apply(
-                    "obj_loc", oid=p["object_id"], node=p["node_id"]
-                )
+            if self._add_location_locked(p["object_id"], p["node_id"]):
+                ready = self._on_object_added(p["object_id"])
+                if rpc_mod.TRACE is not None:
+                    rpc_mod.TRACE.apply(
+                        "obj_loc", oid=p["object_id"], node=p["node_id"]
+                    )
+            else:
+                ready = False
             info = self.running.get(p["task_id"])
             owner = (
                 self._driver_conn(
@@ -907,16 +966,28 @@ class GcsServer:
         return {"ok": True}
 
     def _push_conn(self, conn, channel, payload):
-        self.server.call_soon(
-            lambda c=conn, pl=payload: __import__("asyncio").ensure_future(
-                c.push(channel, pl)
-            )
-        )
+        self.server.send_push(conn, channel, payload)
+
+    def _tombstone_free_locked(self, oid: str) -> None:
+        self._freed_tombstones[oid] = True
+        self._freed_tombstones.move_to_end(oid)
+        while len(self._freed_tombstones) > 8192:
+            self._freed_tombstones.popitem(last=False)
+
+    def _add_location_locked(self, oid: str, node_id: str) -> bool:
+        """Record an object location, unless the owner already freed the
+        object (tombstoned): then the caller must complete the free on
+        the reporting node instead. Returns True when recorded."""
+        if oid in self._freed_tombstones:
+            return False
+        self.directory[oid].add(node_id)
+        return True
 
     def rpc_free_objects(self, p, conn):
         with self._lock:
             homes = defaultdict(list)
             for oid in p["object_ids"]:
+                self._tombstone_free_locked(oid)
                 for nid in self.directory.pop(oid, set()):
                     homes[nid].append(oid)
                 if rpc_mod.TRACE is not None:
@@ -1178,6 +1249,14 @@ class GcsServer:
     def rpc_dag_register(self, p, conn):
         with self._lock:
             dag_id = p["dag_id"]
+            if conn.conn_id not in self.drivers:
+                # the owner's disconnect sweep already ran (its in-flight
+                # register frame outlived the connection): accepting now
+                # would pin stage capacity with no owner left to ever
+                # tear it down. Found by the interleaving explorer
+                # (scenario dag-register-vs-driver-disconnect).
+                return {"ok": False,
+                        "error": "owner driver is not connected"}
             if dag_id in self.dags:
                 return {"ok": False, "error": f"dag {dag_id} already registered"}
             stages = p["stages"]
@@ -1362,9 +1441,8 @@ class GcsServer:
             if c is not None and not c._closed:
                 return c
             addr, port = n["addr"], n["port"]
-        try:
-            c = RpcClient(addr, port, name="gcs", peer=node_id)
-        except OSError:
+        c = self._rt.make_daemon_client(addr, port, node_id)
+        if c is None:
             return None
         with self._lock:
             self._daemon_clients[node_id] = c
@@ -1549,8 +1627,7 @@ class GcsServer:
     # ------------------------------------------------------------- scheduler
 
     def _kick(self):
-        with self._sched_cv:
-            self._sched_cv.notify()
+        self._rt.kick(self)
 
     def _sched_loop(self):
         interval = self.config.scheduler_round_interval_ms / 1000.0
@@ -1767,11 +1844,7 @@ class GcsServer:
             if target is not None:
                 payload = {"task_id": t["task_id"], "status": "UNSCHEDULABLE",
                            "error": reason}
-                self.server.call_soon(
-                    lambda tg=target, pl=payload: __import__("asyncio").ensure_future(
-                        tg.push("task_result", pl)
-                    )
-                )
+                self._push_conn(target, "task_result", payload)
         for t, lost in deps_lost_round:
             self._push_deps_lost(t, lost)
 
@@ -1880,8 +1953,8 @@ class GcsServer:
             # from the reference (which parks infeasible tasks forever with
             # a warning): the round-3 verdict asks for loud rejection of
             # impossible label sets.
-            since = t.setdefault("_label_wait_since", time.time())
-            if time.time() - since > 5.0:
+            since = t.setdefault("_label_wait_since", self._rt.now())
+            if self._rt.now() - since > 5.0:
                 return ("fail",
                         f"no registered node matches hard label "
                         f"constraints {hard} (waited 5s)")
@@ -1917,7 +1990,7 @@ class GcsServer:
         last attempt (resources released / node joined / PG parked) — a
         previous verdict flagged the every-round rescan of all PGs. A 2s
         fallback re-tries regardless, bounding any missed wakeup."""
-        now = time.time()
+        now = self._rt.now()
         if (
             not self._pg_retry_needed
             and now - self._pg_retry_last < 2.0
@@ -1938,10 +2011,11 @@ class GcsServer:
 
     def _spawn_pg_finalizers(self, work: List[tuple]) -> None:
         for pg_id, bundles, node_ids in work:
-            threading.Thread(
-                target=self._finalize_pg, args=(pg_id, bundles, node_ids),
-                daemon=True, name=f"pg-2pc-{pg_id[:8]}",
-            ).start()
+            self._rt.spawn(
+                f"pg-2pc-{pg_id[:8]}",
+                lambda p=pg_id, b=bundles, n=node_ids:
+                    self._finalize_pg(p, b, n),
+            )
 
     def _push_to_node(self, node_id: str, channel: str, data):
         with self._lock:
@@ -1953,9 +2027,7 @@ class GcsServer:
                         conn = c
                         break
         if conn is not None:
-            self.server.call_soon(
-                lambda: __import__("asyncio").ensure_future(conn.push(channel, data))
-            )
+            self.server.send_push(conn, channel, data)
 
     # ---------------------------------------------------------- failure path
 
@@ -1963,7 +2035,18 @@ class GcsServer:
         node_id = conn.meta.get("node_id")
         driver_id = conn.meta.get("driver_id")
         if node_id:
-            self._mark_node_dead(node_id, "daemon connection lost")
+            # Only the REGISTERED connection's loss means the daemon is
+            # gone: a reconnecting daemon re-registers on a new conn
+            # before (or after) the old conn's disconnect lands, and the
+            # stale disconnect must not kill the re-registered node —
+            # the same supersede race the driver path below has always
+            # guarded. Found by the interleaving explorer
+            # (analysis/explore.py, scenario node-reconnect-instance).
+            with self._lock:
+                n = self.nodes.get(node_id)
+                stale = n is not None and n.get("conn_id") != conn.conn_id
+            if not stale:
+                self._mark_node_dead(node_id, "daemon connection lost")
         if driver_id:
             dag_sweep = []  # (dag_id, nodes) torn down with their driver
             with self._lock:
@@ -1997,17 +2080,22 @@ class GcsServer:
 
     def _health_loop(self):
         period = self.config.health_check_period_ms / 1000.0
-        timeout = self.config.health_check_timeout_ms / 1000.0
         while not self._stopped:
             time.sleep(period)
-            now = time.time()
-            dead = []
-            with self._lock:
-                for nid, n in self.nodes.items():
-                    if n["alive"] and now - n["last_beat"] > timeout:
-                        dead.append(nid)
-            for nid in dead:
-                self._mark_node_dead(nid, "heartbeat timeout")
+            self._health_check_once()
+
+    def _health_check_once(self):
+        """One liveness sweep (the health loop's body; the explorer drives
+        this directly as a virtual-clock timer step)."""
+        timeout = self.config.health_check_timeout_ms / 1000.0
+        now = self._rt.now()
+        dead = []
+        with self._lock:
+            for nid, n in self.nodes.items():
+                if n["alive"] and now - n["last_beat"] > timeout:
+                    dead.append(nid)
+        for nid in dead:
+            self._mark_node_dead(nid, "heartbeat timeout")
 
     def _mark_node_dead(self, node_id: str, cause: str):
         """Reference: GcsNodeManager::OnNodeFailure — broadcast death, fail
@@ -2211,11 +2299,7 @@ class GcsServer:
                     "task_id": tid, "status": "NODE_DIED", "node_id": node_id,
                     "error": f"node {node_id} died: {cause}",
                 }
-                self.server.call_soon(
-                    lambda t=target, pl=payload: __import__("asyncio").ensure_future(
-                        t.push("task_result", pl)
-                    )
-                )
+                self._push_conn(target, "task_result", payload)
         for meta, lost in deps_lost:
             self._push_deps_lost(meta, lost)
         for nid, pg_id, b_idx in pg_returns:

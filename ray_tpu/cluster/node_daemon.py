@@ -471,13 +471,16 @@ class NodeDaemon:
                              error=f"worker {worker_id} died (exit {w.proc.poll()})")
         if w and w.actor_id:
             # resolve every in-flight actor call on this worker, else the
-            # drivers' actor_call rpcs hang forever
-            stranded = [
-                t for t in list(self._actor_tasks.values())
-                if t.get("actor_id") == w.actor_id
-            ]
+            # drivers' actor_call rpcs hang forever (pop under the lock —
+            # _report_done re-acquires it, so it runs after)
+            with self._lock:
+                stranded = [
+                    t for t in list(self._actor_tasks.values())
+                    if t.get("actor_id") == w.actor_id
+                ]
+                for t in stranded:
+                    self._actor_tasks.pop(t["task_id"], None)
             for t in stranded:
-                self._actor_tasks.pop(t["task_id"], None)
                 self._report_done(
                     t, status="ACTOR_DEAD",
                     error=f"actor worker died (exit {w.proc.poll()})",
@@ -534,8 +537,8 @@ class NodeDaemon:
                 held[b["id"]] = b["owner"]
         # actor calls are tracked by task id (several can be in flight on one
         # worker); pool tasks by the worker's current_task slot
-        t = self._actor_tasks.pop(p["task_id"], None)
         with self._lock:
+            t = self._actor_tasks.pop(p["task_id"], None)
             w = self.workers.get(worker_id)
             if w is not None and t is None and w.current_task is not None \
                     and w.current_task["task_id"] == p["task_id"]:
@@ -713,7 +716,8 @@ class NodeDaemon:
         """Driver -> daemon: run an actor method, await completion (the rpc
         response carries the result metadata; payloads go through the store)."""
         fut = self.server.loop.create_future()
-        self._pending_rpc[p["task_id"]] = fut
+        with self._lock:
+            self._pending_rpc[p["task_id"]] = fut
         self._dispatch_actor_task(p)
         return fut
 
@@ -842,7 +846,8 @@ class NodeDaemon:
                 (w for w in self.workers.values() if w.actor_id == aid), None
             )
         if w is None or w.conn is None:
-            fut = self._pending_rpc.pop(t["task_id"], None)
+            with self._lock:
+                fut = self._pending_rpc.pop(t["task_id"], None)
             if fut is not None:
                 self.server.call_soon(
                     lambda: fut.set_result({
@@ -854,7 +859,8 @@ class NodeDaemon:
                     }) if not fut.done() else None
                 )
             return
-        self._actor_tasks[t["task_id"]] = t
+        with self._lock:
+            self._actor_tasks[t["task_id"]] = t
         if rpc_mod.TRACE is not None:
             # the call reached a hosted worker: it WILL execute (serially,
             # in arrival order) — the unit the per-caller seq-monotonicity
@@ -873,7 +879,8 @@ class NodeDaemon:
                      start=None, end=None, lost=None, borrows=None,
                      borrow_worker=None):
         task_id = t["task_id"]
-        fut = self._pending_rpc.pop(task_id, None)
+        with self._lock:
+            fut = self._pending_rpc.pop(task_id, None)
         payload = {
             "lost": lost or [],
             "task_id": task_id,
@@ -913,7 +920,8 @@ class NodeDaemon:
             self.server.call_soon(
                 lambda: fut.set_result(payload) if not fut.done() else None
             )
-            self._actor_tasks.pop(task_id, None)
+            with self._lock:
+                self._actor_tasks.pop(task_id, None)
             for oid, _ in payload["results"]:
                 try:
                     # _report_done runs on the event loop for actor calls
@@ -1135,7 +1143,10 @@ class NodeDaemon:
     # cross-node frames over dag_push/dag_pull) ---
 
     def _dag_ent(self, dag_id: str) -> dict:
-        return self._dags.setdefault(dag_id, {"stages": {}, "keys": set()})
+        with self._lock:
+            return self._dags.setdefault(
+                dag_id, {"stages": {}, "keys": set()}
+            )
 
     def rpc_dag_start_stage(self, p, conn):
         """Driver -> daemon: pin a worker and start a compiled-DAG stage's
@@ -1149,20 +1160,35 @@ class NodeDaemon:
         dag_id, stage, spec = p["dag_id"], p["stage"], p["spec"]
         ent = self._dag_ent(dag_id)
         for c in p.get("own_channels") or ():
+            # the (possibly blocking) shm create runs unlocked; the index
+            # insert re-checks under the lock
+            made = None
             if c["key"] not in self._chan_index:
-                self._chan_index[c["key"]] = Channel.create(
+                made = Channel.create(
                     c["path"], int(p.get("capacity") or 65536), c["key"]
                 )
-            ent["keys"].add(c["key"])
-            self._chan_paths[c["key"]] = c["path"]
-        for e in list(spec.get("in_edges") or ()) + [
-            e for e in spec.get("out_edges") or () if not e.get("remote")
-        ]:
-            ent["keys"].add(e["key"])
-            self._chan_paths[e["key"]] = e["path"]
+            with self._lock:
+                cur = (
+                    self._chan_index.setdefault(c["key"], made)
+                    if made is not None else None
+                )
+                ent["keys"].add(c["key"])
+                self._chan_paths[c["key"]] = c["path"]
+            if made is not None and cur is not made:
+                # lost the race to a concurrent open of the same key:
+                # drop OUR mapping only (close() would set the shared
+                # CLOSED flag and kill the winner's channel)
+                made.detach()
+        with self._lock:
+            for e in list(spec.get("in_edges") or ()) + [
+                e for e in spec.get("out_edges") or () if not e.get("remote")
+            ]:
+                ent["keys"].add(e["key"])
+                self._chan_paths[e["key"]] = e["path"]
         aid = p.get("actor_id")
         fut = self.server.loop.create_future()
-        self._pending_rpc[f"dagstage-{dag_id}-{stage}"] = fut
+        with self._lock:
+            self._pending_rpc[f"dagstage-{dag_id}-{stage}"] = fut
         if aid:
             # actor-bound stage: the loop runs on the worker already
             # hosting the actor (actors stay where they live)
@@ -1172,7 +1198,8 @@ class NodeDaemon:
                     None,
                 )
             if w is None or w.conn is None:
-                self._pending_rpc.pop(f"dagstage-{dag_id}-{stage}", None)
+                with self._lock:
+                    self._pending_rpc.pop(f"dagstage-{dag_id}-{stage}", None)
                 return {"ok": False,
                         "error": f"actor {aid} not hosted on {self.node_id}"}
             self._dispatch_dag_stage(w, dag_id, stage, spec)
@@ -1226,9 +1253,10 @@ class NodeDaemon:
 
     def rpc_dag_stage_ready(self, p, conn):
         """Worker notify: the exec loop is up, out-channels created."""
-        fut = self._pending_rpc.pop(
-            f"dagstage-{p['dag_id']}-{p['stage']}", None
-        )
+        with self._lock:
+            fut = self._pending_rpc.pop(
+                f"dagstage-{p['dag_id']}-{p['stage']}", None
+            )
         if fut is not None:
             self.server.call_soon(
                 lambda: fut.set_result({"ok": True})
@@ -1296,16 +1324,20 @@ class NodeDaemon:
             ChannelTimeoutError,
         )
 
-        ch = self._chan_index.get(key)
-        if ch is None:
+        with self._lock:
+            ch = self._chan_index.get(key)
             path = self._chan_paths.get(key)
+        if ch is None:
             if path is None:
                 return {"ok": False, "closed": True}
             try:
-                ch = Channel.open_wait(path, key, timeout=timeout)
+                opened = Channel.open_wait(path, key, timeout=timeout)
             except (ChannelClosedError, ChannelTimeoutError):
                 return {"ok": False, "closed": False}
-            self._chan_index[key] = ch
+            with self._lock:
+                ch = self._chan_index.setdefault(key, opened)
+            if ch is not opened:
+                opened.detach()  # racer won; drop our duplicate mapping
         try:
             seq, payload = ch.read(timeout=timeout)
             return {"ok": True, "seq": seq, "payload": payload}
@@ -1341,7 +1373,10 @@ class NodeDaemon:
                         _chan.poke_error(path)
             # died before reporting ready: fail the driver's pending
             # dag_start_stage instead of letting it ride out its timeout
-            fut = self._pending_rpc.pop(f"dagstage-{dag_id}-{stage}", None)
+            with self._lock:
+                fut = self._pending_rpc.pop(
+                    f"dagstage-{dag_id}-{stage}", None
+                )
             if fut is not None:
                 self.server.call_soon(
                     lambda f=fut, s=stage: f.set_result({
@@ -1364,7 +1399,8 @@ class NodeDaemon:
         from ray_tpu.dag.channel import Channel
 
         dag_id = p["dag_id"]
-        ent = self._dags.pop(dag_id, None)
+        with self._lock:
+            ent = self._dags.pop(dag_id, None)
         if ent is None:
             return
         with self._lock:
@@ -1379,8 +1415,9 @@ class NodeDaemon:
                     )
                 )
         for key in ent["keys"]:
-            ch = self._chan_index.pop(key, None)
-            path = self._chan_paths.pop(key, None)
+            with self._lock:
+                ch = self._chan_index.pop(key, None)
+                path = self._chan_paths.pop(key, None)
             if ch is not None:
                 try:
                     ch.close()
@@ -1413,34 +1450,44 @@ class NodeDaemon:
         if not ok:
             return {"ok": False, "error": "daemon stopping"}
         key = f"{p['pg_id']}:{p['bundle_index']}"
-        self._bundles[key] = {**p, "state": "PREPARED"}
+        with self._lock:
+            self._bundles[key] = {**p, "state": "PREPARED"}
         return {"ok": True}
 
     def rpc_commit_bundle(self, p, conn):
+        # the whole check-then-commit is one critical section: a
+        # return_bundle push (client dispatch thread) racing this handler
+        # (server loop) could otherwise pop the entry between the get and
+        # the state write — the commit would "succeed" into an orphaned
+        # row the GCS believes returned (cross-thread-field-write checker)
         key = f"{p['pg_id']}:{p['bundle_index']}"
-        ent = self._bundles.get(key)
-        ok = not (ent is None or self._stopped)
-        if rpc_mod.TRACE is not None:
-            # transition=False marks an idempotent re-commit (a chaos-
-            # duplicated frame): legal, and the invariant checker must not
-            # read it as a double-commit
-            rpc_mod.TRACE.apply(
-                "pg_commit", pg=p["pg_id"], bundle=p["bundle_index"],
-                node=self.node_id, ok=ok,
-                transition=ok and ent.get("state") != "COMMITTED",
-            )
-        if not ok:
-            # commit without a surviving prepare (daemon restarted between
-            # phases): refuse so the GCS returns the bundle and re-packs
-            return {"ok": False, "error": "no prepared bundle"}
-        ent["state"] = "COMMITTED"
+        with self._lock:
+            ent = self._bundles.get(key)
+            ok = not (ent is None or self._stopped)
+            if rpc_mod.TRACE is not None:
+                # transition=False marks an idempotent re-commit (a chaos-
+                # duplicated frame): legal, and the invariant checker must
+                # not read it as a double-commit
+                rpc_mod.TRACE.apply(
+                    "pg_commit", pg=p["pg_id"], bundle=p["bundle_index"],
+                    node=self.node_id, ok=ok,
+                    transition=ok and ent.get("state") != "COMMITTED",
+                )
+            if not ok:
+                # commit without a surviving prepare (daemon restarted
+                # between phases): refuse so the GCS returns the bundle
+                # and re-packs
+                return {"ok": False, "error": "no prepared bundle"}
+            ent["state"] = "COMMITTED"
         return {"ok": True}
-
 
     def _on_return_bundle(self, p):
         """GCS aborts/releases a 2PC bundle reservation (failed prepare
         round, PG removal, gang reset after a member node death)."""
-        popped = self._bundles.pop(f"{p['pg_id']}:{p['bundle_index']}", None)
+        with self._lock:
+            popped = self._bundles.pop(
+                f"{p['pg_id']}:{p['bundle_index']}", None
+            )
         if popped is not None and rpc_mod.TRACE is not None:
             rpc_mod.TRACE.apply(
                 "pg_return", pg=p["pg_id"], bundle=p["bundle_index"],

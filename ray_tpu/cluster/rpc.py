@@ -328,6 +328,15 @@ class RpcServer:
         except RuntimeError:  # loop closed during shutdown
             pass
 
+    def send_push(self, conn: ServerConn, channel: str, data: Any):
+        """Thread-safe single-connection push. Every GCS-originated push
+        funnels through here (or broadcast) — the seam the virtual
+        runtime's in-process server overrides to turn pushes into
+        schedulable events (see ray_tpu/cluster/runtime.py)."""
+        self.call_soon(
+            lambda: asyncio.ensure_future(conn.push(channel, data))
+        )
+
     def stop(self):
         def _stop():
             if self._server:

@@ -234,6 +234,15 @@ print("protocol trace: %s (%d violations)" % (trace_path, len(violations)),
       flush=True)
 for v in violations:
     print("  " + v.format(), flush=True)
+# interleaving coverage: distinct ordered adjacent handler pairs the GCS
+# actually observed — the same coverage language the deterministic
+# explorer reports (analysis/explore.py), so a soak and an exploration
+# are comparable: a pair neither produced was never tested by either
+from ray_tpu.analysis.explore import interleaving_coverage
+
+pairs = interleaving_coverage(invariants.read_trace(trace_path))
+print("interleaving coverage: %d distinct handler-pair orderings "
+      "observed at the GCS" % len(pairs), flush=True)
 print("SOAK DONE; task errors:", stats["errors"], flush=True)
 if violations:
     raise SystemExit(1)

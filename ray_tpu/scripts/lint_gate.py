@@ -13,7 +13,13 @@ Runs, in order, failing fast with a distinct exit code per contract:
 3. a ``--dump-protocol`` extraction (the protocol model must stay
    parseable) cross-checked against the invariant checker's METHOD_TABLE
    — every rpc method the dynamic half models must exist statically;
-4. optionally (``--tier1``) the tier-1 pytest run with ``--durations=25``,
+4. optionally (``--explore``) a budgeted run of the deterministic
+   control-plane model checker (analysis/explore.py) over the full
+   scenario library — wall-capped per scenario for the 2-CPU CI box;
+   any invariant violation on any explored interleaving fails the gate
+   (artifact: ``explore.json`` with per-scenario schedule counts and
+   handler-pair coverage);
+5. optionally (``--tier1``) the tier-1 pytest run with ``--durations=25``,
    teeing output to an artifact file so CI keeps a per-test timing
    budget trail (see BENCH_NOTES.md "Tier-1 wall-cap hygiene").
 
@@ -44,6 +50,17 @@ TIER1_CMD = (
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--explore", action="store_true",
+                    help="also run a budgeted exploration of the full "
+                         "model-checker scenario library; nonzero exit "
+                         "on any violated interleaving")
+    ap.add_argument("--explore-budget", type=int, default=1400,
+                    help="DFS schedules per scenario (default 1400)")
+    ap.add_argument("--explore-samples", type=int, default=800,
+                    help="random schedules per scenario (default 800)")
+    ap.add_argument("--explore-wall-cap", type=float, default=60.0,
+                    help="seconds per scenario (default 60, sized for "
+                         "the 2-CPU box)")
     ap.add_argument("--tier1", action="store_true",
                     help="also run the tier-1 suite with --durations=25 "
                          "and save the output as an artifact")
@@ -117,7 +134,50 @@ def main(argv=None) -> int:
           f"{len(model['calls'])} call sites; invariant method table "
           "round-trips")
 
-    # (4) tier-1 with per-test durations as a CI artifact
+    # (4) budgeted interleaving exploration of the scenario library
+    if args.explore:
+        from ray_tpu.analysis import explore as _explore
+
+        report = {}
+        failed = None
+        total = 0
+        for name in sorted(_explore.SCENARIOS):
+            res = _explore.explore(
+                _explore.SCENARIOS[name],
+                max_schedules=args.explore_budget,
+                samples=args.explore_samples,
+                wall_cap_s=args.explore_wall_cap,
+            )
+            print("explore: " + res.summary())
+            total += res.schedules_run
+            report[name] = {
+                "schedules": res.schedules_run,
+                "pruned": res.branches_pruned,
+                "coverage_pairs": len(res.coverage),
+                "violations": [
+                    v.format()
+                    for v in (res.violating.violations if res.found else [])
+                ],
+                "shrunk": res.shrunk,
+            }
+            if res.found and failed is None:
+                failed = name
+                cex = os.path.join(args.artifact_dir, "explore_replay.json")
+                _explore.write_replay(cex, res)
+                print(f"lint_gate: counterexample replay: {cex} "
+                      "(python -m ray_tpu.analysis --replay)",
+                      file=sys.stderr)
+        with open(os.path.join(args.artifact_dir, "explore.json"),
+                  "w") as f:
+            json.dump(report, f, indent=2)
+        if failed is not None:
+            print(f"lint_gate: scenario {failed} has a violated "
+                  "interleaving", file=sys.stderr)
+            return 1
+        print(f"explore: {total} schedules across "
+              f"{len(report)} scenarios, 0 violations")
+
+    # (5) tier-1 with per-test durations as a CI artifact
     if args.tier1:
         art = os.path.join(args.artifact_dir, "tier1_durations.txt")
         with open(art, "w") as f:

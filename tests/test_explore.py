@@ -1,0 +1,621 @@
+"""Tests for the deterministic control-plane model checker
+(ray_tpu/analysis/explore.py) and the static state-machine half
+(ray_tpu/analysis/statemachine.py + the two lifecycle checkers).
+
+Covers: explorer determinism (same seed + scenario => byte-identical
+schedule log and identical violation set), the seeded known-bug
+regression harness (found within a bounded budget, shrunk to <= 10
+steps, --replay reproduces it exactly), clean runs of the scenario
+library, the regressions for the three real bugs the explorer found
+(stale-conn node death, dag register after the owner's disconnect
+sweep, free racing a first task_done report), interleave points,
+coverage accounting, state-machine extraction, and firing/clean/pragma
+cases for both new checkers.
+"""
+
+import json
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from ray_tpu.analysis import explore as ex
+from ray_tpu.analysis import statemachine as sm
+from ray_tpu.analysis.core import analyze_paths, iter_modules
+
+SEEDED_BUG = ["register-node-double-book"]
+
+
+def lint(tmp_path, source, select=None, name="gcs.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(source))
+    res = analyze_paths([str(p)], root=str(tmp_path), select=select)
+    return res.findings
+
+
+def run_default(name, **kw):
+    return ex.run_world(ex.SCENARIOS[name], ex.Chooser(), **kw)
+
+
+# ------------------------------------------------------------ quiescence
+
+
+@pytest.mark.parametrize("name", sorted(ex.SCENARIOS))
+def test_default_schedule_is_clean_and_quiesces(name):
+    res = run_default(name)
+    assert res.quiesced
+    assert res.violations == []
+
+
+def test_small_budget_sweep_is_clean():
+    for name, res in ex.explore_all(max_schedules=60, samples=30,
+                                    seed=11).items():
+        assert not res.found, (name, res.violating and [
+            v.format() for v in res.violating.violations
+        ])
+        assert res.schedules_run > 0
+
+
+# ----------------------------------------------------------- determinism
+
+
+def test_exploration_deterministic_same_seed():
+    kw = dict(max_schedules=80, samples=40, seed=13)
+    a = ex.explore(ex.SCENARIOS["watchdog-resend"], **kw)
+    b = ex.explore(ex.SCENARIOS["watchdog-resend"], **kw)
+    assert a.schedules_run == b.schedules_run
+    assert a.branches_pruned == b.branches_pruned
+    assert a.coverage == b.coverage
+    assert a.found == b.found
+
+
+def test_run_world_byte_identical_schedule_log():
+    a = run_default("node-reconnect-instance")
+    b = run_default("node-reconnect-instance")
+    assert a.schedule_log() == b.schedule_log()
+    assert [v.format() for v in a.violations] == \
+        [v.format() for v in b.violations]
+
+
+def test_conn_ids_are_world_local():
+    # labels embed conn ids; two worlds must produce identical labels
+    a = run_default("node-reconnect-instance")
+    b = run_default("node-reconnect-instance")
+    assert a.schedule == b.schedule
+    assert any(s.startswith("drop-conn:") for s in a.schedule)
+
+
+def test_random_sampling_deterministic_per_seed():
+    import random
+
+    r1 = ex.run_world(ex.SCENARIOS["watchdog-resend"],
+                      ex.Chooser(rng=random.Random(42)))
+    r2 = ex.run_world(ex.SCENARIOS["watchdog-resend"],
+                      ex.Chooser(rng=random.Random(42)))
+    assert r1.schedule == r2.schedule
+
+
+# ------------------------------------------------- seeded-bug regression
+
+
+@pytest.fixture(scope="module")
+def seeded_result():
+    return ex.explore(
+        ex.SCENARIOS["node-reconnect-instance"],
+        max_schedules=300, samples=300, seed=5, seeded_bugs=SEEDED_BUG,
+    )
+
+
+def test_seeded_bug_found_within_budget(seeded_result):
+    assert seeded_result.found
+    assert seeded_result.schedules_run <= 600
+    assert seeded_result.violating.violation_kinds & {
+        "capacity", "exactly-once"
+    }
+
+
+def test_seeded_bug_shrinks_to_at_most_10_steps(seeded_result):
+    assert seeded_result.shrunk is not None
+    assert len(seeded_result.shrunk) <= 10
+
+
+def test_seeded_bug_replay_reproduces_exactly(seeded_result, tmp_path):
+    p = tmp_path / "cex.json"
+    ex.write_replay(str(p), seeded_result, seeded_bugs=SEEDED_BUG)
+    rec = json.loads(p.read_text())
+    assert rec["scenario"] == "node-reconnect-instance"
+    assert rec["seeded_bugs"] == SEEDED_BUG
+    r1 = ex.replay(str(p))
+    r2 = ex.replay(str(p))
+    assert r1.violations and r2.violations
+    assert [v.format() for v in r1.violations] == \
+        [v.format() for v in r2.violations]
+    assert r1.schedule == r2.schedule == rec["schedule"]
+
+
+def test_seeded_bug_off_means_clean_on_same_schedule(seeded_result,
+                                                     tmp_path):
+    # the shrunk counterexample is specific to the seeded bug: the FIXED
+    # protocol runs the same schedule clean
+    r = ex.run_world(
+        ex.SCENARIOS["node-reconnect-instance"],
+        ex.Chooser(seeded_result.shrunk, stop_after=True),
+    )
+    assert r.violations == []
+
+
+def test_replay_unknown_scenario_rejected(tmp_path):
+    p = tmp_path / "bogus.json"
+    p.write_text(json.dumps({"scenario": "no-such", "schedule": []}))
+    with pytest.raises(ValueError):
+        ex.replay(str(p))
+
+
+def test_bogus_prefix_diverges():
+    with pytest.raises(ex.ScheduleDiverged):
+        ex.run_world(ex.SCENARIOS["watchdog-resend"],
+                     ex.Chooser(["no-such-step"]))
+
+
+def test_stop_after_truncates_run():
+    full = run_default("watchdog-resend")
+    r = ex.run_world(ex.SCENARIOS["watchdog-resend"],
+                     ex.Chooser(full.schedule[:3], stop_after=True))
+    assert r.schedule == full.schedule[:3]
+    assert not r.quiesced
+
+
+# --------------------------------------------- real-bug regressions (PR 6)
+
+
+def test_stale_conn_disconnect_does_not_kill_reregistered_node():
+    # reg i1 -> reg i2 (new conn) -> old conn's late disconnect: the
+    # node must stay alive (explorer-found bug in gcs._on_disconnect)
+    full = run_default("node-reconnect-instance")
+    order = [s for s in full.schedule if s.startswith(
+        ("reg:d0", "drop-conn:")
+    )]
+    assert order[0].startswith("reg:d0/i1")
+    i2 = next(s for s in full.schedule if s.startswith("reg:d0/i2"))
+    drop = next(s for s in full.schedule if s.startswith("drop-conn:"))
+    assert full.schedule.index(i2) < full.schedule.index(drop)
+    assert full.violations == []
+
+
+def test_dag_register_after_disconnect_sweep_is_refused():
+    # driver registers, disconnects, THEN its in-flight dag_register
+    # lands: the GCS must refuse (no owner left to tear it down)
+    sched = ["reg:d0/i1", "reg-driver:drv0", "disc:drv0", "dag:reg:g1"]
+    r = ex.run_world(ex.SCENARIOS["dag-register-vs-driver-disconnect"],
+                     ex.Chooser(sched, stop_after=True))
+    assert r.violations == []
+
+
+def test_register_driver_on_closed_conn_is_refused():
+    # the disconnect cleanup already ran for the conn: a registration
+    # dispatched late must not resurrect the presence entry
+    sched = ["reg:d0/i1", "disc:drv0", "reg-driver:drv0", "dag:reg:g1"]
+    r = ex.run_world(ex.SCENARIOS["dag-register-vs-driver-disconnect"],
+                     ex.Chooser(sched, stop_after=True))
+    assert r.violations == []
+
+
+def test_free_racing_first_task_done_leaves_no_ghost_location():
+    # owner frees the output BEFORE the producer's first task_done
+    # lands: the tombstone completes the free instead of re-adding the
+    # location (explorer-found bug; the old code ghosted the directory)
+    sched = ["sub:t1", "reg:d0/i1", "sched", "push:exec_tasks->d0",
+             "run:t1@d0", "free:t1-out", "done:t1@d0"]
+    r = ex.run_world(ex.SCENARIOS["watchdog-resend"],
+                     ex.Chooser(sched, stop_after=True))
+    assert r.violations == []
+
+
+# -------------------------------------------------- interleave + pruning
+
+
+# drive the 2PC finalizer BEFORE the node kill so the prepare/commit
+# phase gap (the fault hook) is actually reached
+_PG_PREFIX = ["reg-driver:drv0", "reg:d0/i1", "reg:d1/i1",
+              "pg:create:p1", "gcs:blocking"]
+
+
+def test_pg_fault_hook_is_an_interleave_point():
+    res = ex.run_world(ex.SCENARIOS["pg-2pc-vs-node-death"],
+                       ex.Chooser(_PG_PREFIX))
+    gaps = [o for o in res.options_at if o and o[0] == ex.CONTINUE]
+    assert gaps, "pg fault hook never reached"
+    assert ex.CONTINUE in res.schedule
+    assert res.violations == []
+
+
+def test_node_death_between_prepare_and_commit_is_clean():
+    probe = ex.run_world(ex.SCENARIOS["pg-2pc-vs-node-death"],
+                         ex.Chooser(_PG_PREFIX))
+    gap_i = next(
+        i for i, o in enumerate(probe.options_at)
+        if o and o[0] == ex.CONTINUE
+    )
+    kill = next(
+        s for s in probe.options_at[gap_i] if s.startswith("kill:")
+    )
+    sched = probe.schedule[:gap_i] + [kill]
+    r = ex.run_world(ex.SCENARIOS["pg-2pc-vs-node-death"],
+                     ex.Chooser(sched))
+    assert r.violations == []
+    # the kill really landed inside the 2PC gap
+    k = r.schedule.index(kill)
+    assert ex.CONTINUE in r.schedule[k:]
+
+
+def test_conflict_relation():
+    assert ex._conflicts(frozenset({"a"}), frozenset({"a", "b"}))
+    assert not ex._conflicts(frozenset({"a"}), frozenset({"b"}))
+    assert ex._conflicts(frozenset({ex.GLOBAL_KEY}), frozenset({"b"}))
+
+
+def test_pruning_skips_commuting_alternative():
+    res = ex.WorldResult(
+        scenario="s",
+        schedule=["a", "b"],
+        options_at=[("a", "b"), ("b",)],
+        keys_of={"a": frozenset({"x"}), "b": frozenset({"y"})},
+        violations=[], events=[], quiesced=True,
+    )
+    # b at position 0 commutes with a (disjoint keys): pruned
+    assert ex._backtrack_alternatives(res, 0, None) == []
+    res.keys_of["b"] = frozenset({"x"})
+    assert ex._backtrack_alternatives(res, 0, None) == [(0, "b")]
+
+
+def test_interleaving_coverage_counts_adjacent_recv_pairs():
+    events = [
+        {"t": "recv", "dst": "gcs", "m": "a"},
+        {"t": "apply", "k": "x"},
+        {"t": "recv", "dst": "gcs", "m": "b"},
+        {"t": "recv", "dst": "gcs", "m": "a"},
+        {"t": "recv", "dst": "other", "m": "z"},
+    ]
+    assert ex.interleaving_coverage(events) == {("a", "b"), ("b", "a")}
+
+
+def test_explore_reports_coverage_and_counts():
+    r = ex.explore(ex.SCENARIOS["watchdog-resend"], max_schedules=40,
+                   samples=10, seed=1)
+    assert r.coverage
+    assert r.schedules_run == r.dfs_schedules + r.sampled_schedules
+    assert "schedules" in r.summary()
+
+
+# ------------------------------------------------------------------ CLI
+
+
+def test_cli_explore_clean_exit_zero():
+    p = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.analysis", "--explore",
+         "watchdog-resend", "--budget", "30", "--samples", "10"],
+        capture_output=True, text=True,
+    )
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "no violations" in p.stdout
+
+
+def test_cli_explore_seeded_bug_exit_one(tmp_path):
+    replay = tmp_path / "cex.json"
+    p = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.analysis", "--explore",
+         "node-reconnect-instance", "--budget", "150", "--samples",
+         "300", "--seed-bug", "register-node-double-book",
+         "--save-replay", str(replay)],
+        capture_output=True, text=True,
+    )
+    assert p.returncode == 1, p.stdout + p.stderr
+    assert "VIOLATION" in p.stdout
+    q = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.analysis", "--replay",
+         str(replay)],
+        capture_output=True, text=True,
+    )
+    assert q.returncode == 1, q.stdout + q.stderr
+
+
+def test_cli_list_scenarios():
+    p = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.analysis", "--list-scenarios"],
+        capture_output=True, text=True,
+    )
+    assert p.returncode == 0
+    for name in ex.SCENARIOS:
+        assert name in p.stdout
+
+
+# ------------------------------------------- state-machine extraction
+
+
+@pytest.fixture(scope="module")
+def tree_writes():
+    writes = []
+    for ctx in iter_modules(["ray_tpu/cluster/gcs.py",
+                             "ray_tpu/cluster/node_daemon.py"]):
+        writes += sm.extract_module(ctx)
+    return writes
+
+
+def test_extraction_finds_actor_lifecycle_writes(tree_writes):
+    actor = [w for w in tree_writes if w.entity == "actor"]
+    values = {w.value for w in actor}
+    assert {"PENDING", "STARTING", "ALIVE", "RESTARTING", "DEAD",
+            "RESTARTING_GCS"} <= values
+    assert any(w.creation and w.value == "PENDING" for w in actor)
+
+
+def test_extraction_observes_branch_guards(tree_writes):
+    # _mark_node_dead: pg["state"] = "PENDING" under
+    # `if pg.get("state") in ("CREATED", "PREPARING")`
+    w = next(
+        w for w in tree_writes
+        if w.entity == "pg" and w.func == "_mark_node_dead"
+    )
+    assert w.observed == frozenset({"CREATED", "PREPARING"})
+
+
+def test_extraction_covers_ifexp_arms(tree_writes):
+    # rpc_task_done: a["state"] = "PENDING" if retryable else "DEAD"
+    vals = {
+        w.value for w in tree_writes
+        if w.entity == "actor" and w.func == "rpc_task_done"
+    }
+    assert {"PENDING", "DEAD", "ALIVE"} <= vals
+
+
+def test_extraction_includes_bundle_and_task_status(tree_writes):
+    assert any(w.entity == "bundle" and w.value == "COMMITTED"
+               for w in tree_writes)
+    assert any(w.entity == "task-status" and w.value == "NODE_DIED"
+               for w in tree_writes)
+
+
+def test_declared_machines_accept_the_tree(tree_writes):
+    assert sm.check_writes(tree_writes) == []
+
+
+def test_unknown_state_rejected():
+    w = sm.StateWrite(
+        entity="actor", field="state", value="ZOMBIE", path="gcs.py",
+        line=1, end_line=1, line_text="", func="f", creation=False,
+        observed=frozenset(),
+    )
+    problems = sm.check_writes([w])
+    assert len(problems) == 1 and "not a declared state" in problems[0][1]
+
+
+def test_noninitial_creation_rejected():
+    w = sm.StateWrite(
+        entity="pg", field="state", value="CREATED", path="gcs.py",
+        line=1, end_line=1, line_text="", func="f", creation=True,
+        observed=frozenset(),
+    )
+    problems = sm.check_writes([w])
+    assert len(problems) == 1 and "initial" in problems[0][1]
+
+
+def test_guarded_illegal_transition_rejected():
+    w = sm.StateWrite(
+        entity="actor", field="state", value="ALIVE", path="gcs.py",
+        line=1, end_line=1, line_text="", func="f", creation=False,
+        observed=frozenset({"DEAD"}),
+    )
+    problems = sm.check_writes([w])
+    assert len(problems) == 1 and "no declared edge" in problems[0][1]
+
+
+def test_extractor_ignores_other_modules(tmp_path):
+    src = 'class X:\n    def f(self, a):\n        a["state"] = "BOGUS"\n'
+    p = tmp_path / "other.py"
+    p.write_text(src)
+    ctx = next(iter_modules([str(p)], root=str(tmp_path)))
+    assert sm.extract_module(ctx) == []
+
+
+# --------------------------------------- illegal-state-transition checker
+
+
+def test_illegal_state_transition_fires(tmp_path):
+    findings = lint(
+        tmp_path,
+        """
+        class GcsServer:
+            def __init__(self):
+                self.actors = {}
+
+            def rpc_oops(self, p, conn):
+                a = self.actors.get(p["actor_id"])
+                if a["state"] == "DEAD":
+                    a["state"] = "ALIVE"
+        """,
+        select=["illegal-state-transition"],
+    )
+    assert len(findings) == 1
+    assert "DEAD" in findings[0].message
+
+
+def test_illegal_state_transition_unknown_state(tmp_path):
+    findings = lint(
+        tmp_path,
+        """
+        class GcsServer:
+            def __init__(self):
+                self.placement_groups = {}
+
+            def rpc_x(self, p, conn):
+                pg = self.placement_groups[p["pg_id"]]
+                pg["state"] = "CREATD"
+        """,
+        select=["illegal-state-transition"],
+    )
+    assert len(findings) == 1
+    assert "CREATD" in findings[0].message
+
+
+def test_illegal_state_transition_clean(tmp_path):
+    findings = lint(
+        tmp_path,
+        """
+        class GcsServer:
+            def __init__(self):
+                self.actors = {}
+
+            def rpc_ok(self, p, conn):
+                a = self.actors.get(p["actor_id"])
+                if a["state"] == "STARTING":
+                    a["state"] = "ALIVE"
+        """,
+        select=["illegal-state-transition"],
+    )
+    assert findings == []
+
+
+def test_illegal_state_transition_pragma(tmp_path):
+    findings = lint(
+        tmp_path,
+        """
+        class GcsServer:
+            def __init__(self):
+                self.actors = {}
+
+            def rpc_oops(self, p, conn):
+                a = self.actors.get(p["actor_id"])
+                if a["state"] == "DEAD":
+                    a["state"] = "ALIVE"  # ray-lint: disable=illegal-state-transition
+        """,
+        select=["illegal-state-transition"],
+    )
+    assert findings == []
+
+
+# ----------------------------------------- cross-thread-field-write checker
+
+
+_RACY = """
+class NodeDaemon:
+    def __init__(self):
+        import threading
+        self._lock = threading.Lock()
+        self._table = {}
+        threading.Thread(target=self._beat_loop).start()
+
+    def rpc_put(self, p, conn):
+        self._table[p["k"]] = p["v"]@PRAGMA@
+
+    def _beat_loop(self):
+        while True:
+            self._table.pop("stale", None)
+"""
+
+
+def test_cross_thread_field_write_fires(tmp_path):
+    findings = lint(
+        tmp_path, _RACY.replace("@PRAGMA@", ""),
+        select=["cross-thread-field-write"], name="node_daemon.py",
+    )
+    assert len(findings) == 2  # both unlocked sites
+    assert "_table" in findings[0].message
+
+
+def test_cross_thread_field_write_pragma(tmp_path):
+    findings = lint(
+        tmp_path,
+        _RACY.replace(
+            "@PRAGMA@", "  # ray-lint: disable=cross-thread-field-write"
+        ),
+        select=["cross-thread-field-write"], name="node_daemon.py",
+    )
+    assert len(findings) == 1  # only the loop-side site remains
+
+
+def test_cross_thread_field_write_clean_with_lock(tmp_path):
+    findings = lint(
+        tmp_path,
+        """
+        class NodeDaemon:
+            def __init__(self):
+                import threading
+                self._lock = threading.Lock()
+                self._table = {}
+                threading.Thread(target=self._beat_loop).start()
+
+            def rpc_put(self, p, conn):
+                with self._lock:
+                    self._table[p["k"]] = p["v"]
+
+            def _beat_loop(self):
+                with self._lock:
+                    self._table.pop("stale", None)
+        """,
+        select=["cross-thread-field-write"], name="node_daemon.py",
+    )
+    assert findings == []
+
+
+def test_cross_thread_field_write_single_context_silent(tmp_path):
+    findings = lint(
+        tmp_path,
+        """
+        class NodeDaemon:
+            def __init__(self):
+                self._table = {}
+
+            def rpc_put(self, p, conn):
+                self._table[p["k"]] = p["v"]
+
+            def rpc_del(self, p, conn):
+                self._table.pop(p["k"], None)
+        """,
+        select=["cross-thread-field-write"], name="node_daemon.py",
+    )
+    assert findings == []
+
+
+def test_cross_thread_field_write_lock_propagates_to_helper(tmp_path):
+    findings = lint(
+        tmp_path,
+        """
+        class NodeDaemon:
+            def __init__(self):
+                import threading
+                self._lock = threading.Lock()
+                self._table = {}
+                threading.Thread(target=self._beat_loop).start()
+
+            def rpc_put(self, p, conn):
+                with self._lock:
+                    self._store(p)
+
+            def _store(self, p):
+                self._table[p["k"]] = p["v"]
+
+            def _beat_loop(self):
+                with self._lock:
+                    self._table.pop("stale", None)
+        """,
+        select=["cross-thread-field-write"], name="node_daemon.py",
+    )
+    assert findings == []
+
+
+def test_cross_thread_field_write_outside_daemon_modules_silent(tmp_path):
+    findings = lint(
+        tmp_path, _RACY.replace("@PRAGMA@", ""),
+        select=["cross-thread-field-write"], name="something_else.py",
+    )
+    assert findings == []
+
+
+def test_both_new_checkers_clean_on_repo_tree():
+    res = analyze_paths(
+        ["ray_tpu/cluster/gcs.py", "ray_tpu/cluster/node_daemon.py"],
+        select=["illegal-state-transition", "cross-thread-field-write"],
+    )
+    assert res.findings == []
+    assert res.errors == []
