@@ -758,7 +758,7 @@ def explore_bench(budget=1400, samples=800):
     }
 
 
-def dag_loop_bench(n_stages=3, iters=300, remote_iters=40):
+def dag_loop_bench(n_stages=3, iters=None, remote_iters=40):
     """Compiled-graph hot loop vs the equivalent `.remote()` chain on a
     3-stage local-cluster pipeline (the ISSUE-4 acceptance metric): the
     compiled path's per-iteration dispatch is channel writes/reads only —
@@ -769,8 +769,13 @@ def dag_loop_bench(n_stages=3, iters=300, remote_iters=40):
     The embedded cluster shares one GIL across GCS + daemons (workers are
     real subprocesses), which flatters neither path: both comparators run
     on the identical topology."""
+    import os
+
     import ray_tpu
     from ray_tpu.dag import InputNode
+
+    if iters is None:  # obs_overhead raises this for a stabler on/off diff
+        iters = int(os.environ.get("RAY_TPU_BENCH_DAG_ITERS", "300"))
 
     ray_tpu.init(cluster=True, num_nodes=1, num_cpus=max(n_stages + 1, 4),
                  config={"log_to_driver": False})
@@ -822,6 +827,156 @@ def dag_loop_bench(n_stages=3, iters=300, remote_iters=40):
         ray_tpu.shutdown()
 
 
+def _bench_subprocess(mode, env_overrides, timeout_s=900):
+    """Run `python bench.py <mode>` in a child (env knobs like
+    RAY_TPU_metrics_enabled must be set before ANY import, and worker
+    subprocesses inherit them) and parse its one-line JSON result."""
+    import os
+    import subprocess
+
+    env = dict(os.environ)
+    env.update(env_overrides)
+    r = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), mode],
+        env=env, capture_output=True, text=True, timeout=timeout_s,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    for line in reversed(r.stdout.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            continue
+    raise RuntimeError(
+        f"bench {mode} emitted no JSON (rc={r.returncode}):\n"
+        f"{r.stdout[-1500:]}\n{r.stderr[-1500:]}"
+    )
+
+
+def obs_frame_overhead():
+    """Deterministic per-op cost of the observability plane on the dag
+    channel hot path: a same-thread write+read ping-pong (no peer, no
+    blocking, no scheduler wakeups — the quantities wall-clock A/B cannot
+    resolve on this shared 2-CPU box) with metrics + flight recorder
+    toggled IN-PROCESS. Also measures the per-rpc handler-timing wrapper
+    cost the GCS/daemon `_handle` hooks add. Both are min-of-reps, so the
+    numbers are stable to ~0.1us."""
+    import os
+    import tempfile
+
+    from ray_tpu.cluster import rpc as _rpc
+    from ray_tpu.dag.channel import Channel
+    from ray_tpu.util import metrics as _m
+
+    d = tempfile.mkdtemp(prefix="obs_bench_")
+    ch = Channel.create(os.path.join(d, "ch"), 1 << 16, "bench-edge")
+    payload = b"x" * 128
+
+    def pingpong(reps=30_000, tries=5):
+        best = float("inf")
+        for _ in range(tries):
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                ch.write(payload, timeout=5)
+                ch.read(timeout=5)
+            best = min(best, (time.perf_counter() - t0) / reps)
+        return best * 1e6  # us per write+read pair
+
+    prev_en, prev_tr = _m.ENABLED, _rpc.TRACE
+    try:
+        _m.ENABLED, _rpc.TRACE = False, None
+        pair_off = pingpong()
+        _m.ENABLED = True
+        from ray_tpu.obs.flightrec import FlightRecorder
+
+        _rpc.TRACE = FlightRecorder()
+        pair_on = pingpong()
+    finally:
+        _m.ENABLED, _rpc.TRACE = prev_en, prev_tr
+        ch.close()
+        ch.detach()
+
+    # per-rpc wrapper cost: what gcs/daemon _handle adds around a handler
+    h = _m.Histogram("ray_tpu_bench_handler_s", "bench-only", tag_keys=("method",))  # ray-lint: disable=metric-name-invalid
+    key = h.series_key({"method": "bench"})
+
+    def wrapper_cost(reps=200_000, tries=5):
+        best = float("inf")
+        for _ in range(tries):
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                s = time.perf_counter()
+                h.observe_k(key, time.perf_counter() - s)
+            best = min(best, (time.perf_counter() - t0) / reps)
+        return best * 1e6
+
+    return {
+        "chan_pair_on_us": round(pair_on, 3),
+        "chan_pair_off_us": round(pair_off, 3),
+        "chan_pair_delta_us": round(pair_on - pair_off, 3),
+        "rpc_handler_wrapper_us": round(wrapper_cost(), 3),
+    }
+
+
+def obs_overhead_bench():
+    """ISSUE-9 acceptance gate: the observability plane (metrics pipeline
+    + always-on flight recorder) must cost < 3% dispatch overhead on the
+    compiled-dag hot loop.
+
+    The GATE is computed from the deterministic in-process frame-cost
+    delta (obs_frame_overhead): a 3-stage compiled iteration crosses 4
+    channel edges = 4 write+read pairs, so the plane's worst-case
+    critical-path cost is 4 * chan_pair_delta_us against the measured
+    baseline iteration. Wall-clock A/B of full dag_loop / cluster-storm
+    subprocess trees is ALSO run and recorded, but on this 2-CPU box its
+    run-to-run spread (+-50% and bimodal, see BENCH_NOTES) exceeds any
+    effect under test — those numbers are context, not the gate."""
+    micro = obs_frame_overhead()
+    log(f"obs_overhead: micro {micro}")
+    on = {"RAY_TPU_metrics_enabled": "1",
+          "RAY_TPU_flight_recorder_enabled": "1",
+          "RAY_TPU_BENCH_DAG_ITERS": "600"}
+    off = {"RAY_TPU_metrics_enabled": "0",
+           "RAY_TPU_flight_recorder_enabled": "0",
+           "RAY_TPU_BENCH_DAG_ITERS": "600"}
+
+    def dag_iter_us(env):
+        runs = [_bench_subprocess("dag_loop", env)["configs"]["dag_loop"]
+                for _ in range(2)]
+        best = min(runs, key=lambda r: r["compiled_iter_us"])
+        return best["compiled_iter_us"], best
+
+    log("obs_overhead: dag_loop e2e A/B (context; noise-dominated)...")
+    dag_on_us, dag_on = dag_iter_us(on)
+    dag_off_us, dag_off = dag_iter_us(off)
+    log(f"  e2e on {dag_on_us}us/iter, off {dag_off_us}us/iter")
+    log("obs_overhead: cluster storm A/B (context)...")
+    storm_on = _bench_subprocess("_storm", on)
+    storm_off = _bench_subprocess("_storm", off)
+
+    # the gate: deterministic per-edge cost x edges, against the measured
+    # baseline iteration (use the better of the two e2e baselines)
+    base_iter_us = min(dag_on_us, dag_off_us)
+    edges = 4  # driver->s1->s2->s3->driver on the 3-stage bench pipeline
+    gate_pct = edges * max(micro["chan_pair_delta_us"], 0.0) \
+        / base_iter_us * 100.0
+    e2e_pct = (dag_on_us / dag_off_us - 1.0) * 100.0
+    return {
+        **micro,
+        "dag_edges_per_iter": edges,
+        "dag_baseline_iter_us": base_iter_us,
+        "dag_dispatch_overhead_pct": round(gate_pct, 3),
+        "meets_3pct_bar": gate_pct < 3.0,
+        "e2e_dag_on_iter_us": dag_on_us,
+        "e2e_dag_off_iter_us": dag_off_us,
+        "e2e_dag_overhead_pct_noisy": round(e2e_pct, 2),
+        "storm_on_tasks_per_sec": storm_on["tasks_per_sec"],
+        "storm_off_tasks_per_sec": storm_off["tasks_per_sec"],
+        "storm_cpu_ms_per_task_on": storm_on["cpu_ms_per_task"],
+        "storm_cpu_ms_per_task_off": storm_off["cpu_ms_per_task"],
+        "dag_on": dag_on, "dag_off": dag_off,
+    }
+
+
 def _tpu_available(timeout_s: float = 120.0) -> bool:
     """Probe the TPU in a SUBPROCESS: a wedged axon tunnel hangs
     jax.devices() forever inside this process, which would take the whole
@@ -856,6 +1011,30 @@ def main():
             "unit": "schedules/s (full scenario library, fresh world "
                     "per schedule, invariant-checked)",
             "configs": {"explore": r},
+        }))
+        return
+
+    if sys.argv[1:] == ["_storm"]:
+        # internal comparator for obs_overhead: a small separate-process
+        # cluster storm (env knobs inherited by the whole process tree)
+        r = cluster_mode_bench(n_nodes=2, cpus_per_node=4, n_tasks=500)
+        print(json.dumps(r))
+        return
+
+    if sys.argv[1:] == ["obs_overhead"]:
+        # observability-plane overhead gate: dag_loop + cluster storm with
+        # metrics+flight-recorder on vs off — prints one JSON line
+        # (recorded as BENCH_obs_rNN.json); acceptance bar < 3% on the
+        # compiled-dag hot loop
+        r = obs_overhead_bench()
+        log(f"obs_overhead gate {r['dag_dispatch_overhead_pct']}% "
+            f"(chan pair +{r['chan_pair_delta_us']}us, e2e noisy "
+            f"{r['e2e_dag_overhead_pct_noisy']}%)")
+        print(json.dumps({
+            "metric": "obs_dag_dispatch_overhead_pct",
+            "value": r["dag_dispatch_overhead_pct"],
+            "unit": "% (compiled dag iter, metrics+recorder on vs off)",
+            "configs": {"obs_overhead": r},
         }))
         return
 

@@ -17,6 +17,7 @@ accumulate across ``check_module`` calls and report from ``finalize``.
 from __future__ import annotations
 
 import ast
+import re
 from typing import Dict, List, Optional, Set, Tuple
 
 from ray_tpu.analysis.core import (
@@ -1604,6 +1605,81 @@ class CrossThreadFieldWriteChecker(Checker):
             if field:
                 out.append((field, node, id(node) in locked_ids))
         return out
+
+
+# ------------------------------------------------- metric hygiene checker
+
+_METRIC_CLASSES = {
+    "ray_tpu.util.metrics.Counter",
+    "ray_tpu.util.metrics.Gauge",
+    "ray_tpu.util.metrics.Histogram",
+}
+_METRIC_NAME_RE = re.compile(r"ray_tpu_[a-z0-9_]+\Z")
+
+
+@register
+class MetricNameChecker(Checker):
+    """Two contracts on Counter/Gauge/Histogram constructions (the
+    observability plane's lint half, ray_tpu.obs):
+
+    - the metric name must match ``ray_tpu_[a-z0-9_]+`` — one namespace,
+      Prometheus-safe, grep-able;
+    - the construction must run at import time (module scope, class body,
+      or ``__init__``): the registry is process-global and permanent, so a
+      metric constructed per call/request leaks a registry entry per
+      unique name and re-registers forever on the hot path.
+
+    Non-literal names are skipped (dynamic factories judge themselves).
+    """
+
+    name = "metric-name-invalid"
+    description = (
+        "metric constructed with a non-`ray_tpu_[a-z0-9_]+` literal name, "
+        "or outside module/__init__ scope (per-call registry leak)"
+    )
+
+    def check_module(self, ctx: ModuleContext) -> List[Finding]:
+        imap = ImportMap(ctx.tree)
+        out: List[Finding] = []
+
+        def visit(node: ast.AST, func_stack: Tuple[str, ...]):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                func_stack = func_stack + (node.name,)
+            elif isinstance(node, ast.Call):
+                resolved = imap.resolve(node.func)
+                if resolved in _METRIC_CLASSES:
+                    self._check_call(ctx, node, resolved, func_stack, out)
+            for child in ast.iter_child_nodes(node):
+                visit(child, func_stack)
+
+        visit(ctx.tree, ())
+        return out
+
+    def _check_call(self, ctx: ModuleContext, node: ast.Call,
+                    resolved: str, func_stack: Tuple[str, ...],
+                    out: List[Finding]) -> None:
+        cls = resolved.rsplit(".", 1)[1]
+        arg = node.args[0] if node.args else next(
+            (kw.value for kw in node.keywords if kw.arg == "name"), None
+        )
+        if not (isinstance(arg, ast.Constant) and isinstance(arg.value, str)):
+            return  # dynamic name: out of scope
+        if not _METRIC_NAME_RE.fullmatch(arg.value):
+            out.append(ctx.finding(
+                node, self.name,
+                f"{cls} name {arg.value!r} does not match "
+                "`ray_tpu_[a-z0-9_]+` — metrics share one cluster-wide "
+                "Prometheus namespace; rename (or suppress with `# ray-"
+                "lint: disable=metric-name-invalid`)",
+            ))
+        if func_stack and func_stack[-1] != "__init__":
+            out.append(ctx.finding(
+                node, self.name,
+                f"{cls} {arg.value!r} constructed inside "
+                f"`{func_stack[-1]}()`: the registry is process-global — "
+                "construct metrics at module//__init__ scope and observe "
+                "per call, or each call leaks a registry entry",
+            ))
 
 
 def static_lock_graph(paths, root=None):

@@ -174,21 +174,34 @@ class ProtocolTracer:
 
 # ------------------------------------------------------------ activation
 
+# Flight recorder displaced by install() (ray_tpu.obs installs a bounded
+# in-memory ring as the default rpc.TRACE): uninstall() puts it back so
+# the always-on black box survives opt-in tracing sessions.
+_displaced = None
+
 
 def install(path: str) -> ProtocolTracer:
     """Make a fresh tracer writing to ``path`` the process-wide trace
     plane (``cluster/rpc.py`` hooks + every apply-event site)."""
+    global _displaced
     from ray_tpu.cluster import rpc as _rpc
 
     tracer = ProtocolTracer(path)
+    prev = _rpc.TRACE
+    if prev is not None and getattr(prev, "is_flight_recorder", False):
+        _displaced = prev
     _rpc.TRACE = tracer
     return tracer
 
 
 def uninstall() -> None:
+    global _displaced
     from ray_tpu.cluster import rpc as _rpc
 
-    tracer, _rpc.TRACE = _rpc.TRACE, None
+    tracer = _rpc.TRACE
+    if tracer is not None and getattr(tracer, "is_flight_recorder", False):
+        return  # nothing opt-in is installed; keep the recorder running
+    _rpc.TRACE, _displaced = _displaced, None
     if tracer is not None:
         tracer.close()
 

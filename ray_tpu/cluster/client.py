@@ -34,6 +34,18 @@ from ray_tpu.core.memory_store import MemoryStore
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.task_spec import TaskSpec, new_id
 from ray_tpu.cluster.rpc import ConnectionLost, RetryingRpcClient, RpcClient
+from ray_tpu.util import metrics as _metrics
+
+# observability (ray_tpu.obs): driver-side submission counters. Visible
+# in the cluster aggregate when the driver shares the GCS process
+# (embedded/local mode); remote drivers read them via their local export.
+_M_TASKS_SUBMITTED = _metrics.Counter(
+    "ray_tpu_client_tasks_submitted_total",
+    "task submissions through this driver (actor calls tagged)",
+    tag_keys=("kind",),
+)
+_K_SUBMIT_TASK = _M_TASKS_SUBMITTED.series_key({"kind": "task"})
+_K_SUBMIT_ACTOR = _M_TASKS_SUBMITTED.series_key({"kind": "actor_call"})
 
 
 class _ActorQueue:
@@ -445,6 +457,11 @@ class ClusterClient:
     # ----------------------------------------------------------- submission
 
     def submit_task(self, spec: TaskSpec) -> List[ObjectRef]:
+        if _metrics.ENABLED:
+            _M_TASKS_SUBMITTED.inc_k(
+                _K_SUBMIT_ACTOR if spec.actor_id is not None
+                and not spec.actor_creation else _K_SUBMIT_TASK
+            )
         refs = [
             ObjectRef.for_task_output(spec.task_id, i, owner=self.worker_id)
             for i in range(spec.num_returns)

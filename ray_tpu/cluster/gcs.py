@@ -29,7 +29,41 @@ from ray_tpu.cluster.runtime import ThreadRuntime
 from ray_tpu.sched.policy import make_policy_from_config
 from ray_tpu.sched.resources import NodeResourceState, ResourceSpace
 from ray_tpu.sched import bundles as bundles_mod
+from ray_tpu.util import metrics as _metrics
 from ray_tpu.util.task_events import TaskEventLog
+
+# --- observability (ray_tpu.obs): GCS-side control-plane metrics, all
+# module-scope (one registry entry per process) and gated on the single
+# _metrics.ENABLED global at each observation site. Handler self-time is
+# the sync portion of the handler body (async continuations like the PG
+# 2PC finalizers are scheduler work, not handler time) — the attribution
+# `ray_tpu metrics --top` ranks.
+_HANDLER_BUCKETS = (
+    0.00001, 0.000025, 0.00005, 0.0001, 0.00025, 0.0005, 0.001,
+    0.0025, 0.005, 0.01, 0.025, 0.1, 0.5, 2.0,
+)
+_M_RPC_HANDLER = _metrics.Histogram(
+    "ray_tpu_gcs_rpc_handler_s",
+    "GCS rpc handler self-time per method",
+    boundaries=_HANDLER_BUCKETS,
+    tag_keys=("method",),
+)
+_M_SCHED_ROUND = _metrics.Histogram(
+    "ray_tpu_gcs_sched_round_s",
+    "scheduler round duration (rounds with work only)",
+    boundaries=_HANDLER_BUCKETS,
+)
+_M_DISPATCH_BATCH = _metrics.Histogram(
+    "ray_tpu_gcs_sched_dispatch_batch",
+    "tasks dispatched per scheduler round",
+    boundaries=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 4096),
+)
+_M_SCHED_PENDING = _metrics.Gauge(
+    "ray_tpu_gcs_sched_pending_tasks",
+    "queued-but-undispatched tasks at the GCS after intake",
+)
+# per-method handler series keys, built once (see util/metrics.series_key)
+_HANDLER_KEYS: Dict[str, tuple] = {}
 
 # TEST-ONLY regression switchboard for the deterministic explorer
 # (ray_tpu/analysis/explore.py): names added here re-introduce known,
@@ -123,6 +157,16 @@ class GcsServer:
             anonymous_spill=_spilling and not persistence_path,
             resume=self._task_events_ckpt,
         )
+
+        # cluster-wide metric aggregate (ray_tpu.obs): fed by node
+        # heartbeat deltas (rpc_heartbeat) + this process's own registry
+        # (folded in lazily by rpc_metrics); served raw by rpc_metrics and
+        # over HTTP by dashboard/head.py /metrics + /api/metrics
+        self.metrics_agg = _metrics.MetricsAggregator()
+        # last-applied metrics_seq per node (dedupes retried heartbeats
+        # whose delta payload is not idempotent); mutated only inside
+        # rpc_heartbeat on the rpc loop
+        self._metrics_seq_seen: Dict[str, int] = {}
 
         # --- scheduler state ---
         # intake: raw submissions, vetted once per round by _intake_locked
@@ -269,7 +313,17 @@ class GcsServer:
         fn = getattr(self, f"rpc_{method}", None)
         if fn is None:
             raise ValueError(f"unknown GCS method {method}")
-        return fn(params or {}, conn)
+        if not _metrics.ENABLED:
+            return fn(params or {}, conn)
+        t0 = time.perf_counter()
+        try:
+            return fn(params or {}, conn)
+        finally:
+            k = _HANDLER_KEYS.get(method)
+            if k is None:
+                k = _HANDLER_KEYS[method] = _M_RPC_HANDLER.series_key(
+                    {"method": method})
+            _M_RPC_HANDLER.observe_k(k, time.perf_counter() - t0)
 
     # --- node lifecycle (reference: gcs_node_manager.cc) ---
 
@@ -308,6 +362,11 @@ class GcsServer:
                     self._mark_node_dead(
                         node_id, "superseded by a new daemon instance"
                     )
+            if prev is None or prev.get("instance") != p.get("instance"):
+                # a NEW daemon process restarts its metrics_seq at 0: a
+                # stale high-water marker would discard the fresh
+                # instance's deltas until its counter caught up
+                self._metrics_seq_seen.pop(node_id, None)
             self.nodes[node_id] = {
                 "node_id": node_id,
                 "addr": p["addr"],
@@ -401,6 +460,20 @@ class GcsServer:
                     # per-node physical stats (reporter-agent analog);
                     # served through get_nodes / the dashboard node table
                     n["stats"] = p["stats"]
+        m = p.get("metrics")
+        if m:
+            # delta snapshot of the node's (daemon + its workers') metric
+            # registries riding the beat — fold into the cluster aggregate
+            # (the aggregator has its own lock; stay off self._lock).
+            # heartbeat is RETRYABLE: the retry plane may resend the SAME
+            # frame after an unanswered window, and the deltas are not
+            # idempotent — dedupe on the per-node metrics_seq stamp.
+            seq = p.get("metrics_seq")
+            node_id = p["node_id"]
+            if seq is None or seq > self._metrics_seq_seen.get(node_id, 0):
+                if seq is not None:
+                    self._metrics_seq_seen[node_id] = seq
+                self.metrics_agg.ingest(node_id, m)
         return {"ok": True}
 
     def rpc_get_nodes(self, p, conn):
@@ -1189,6 +1262,17 @@ class GcsServer:
                 "placement_groups": len(self.placement_groups),
             }
 
+    def rpc_metrics(self, p, conn):
+        """Cluster-aggregated metrics (ray_tpu.obs). Folds this process's
+        own registry delta in under the ``head`` source first, so the
+        GCS's handler/scheduler series are always current, then renders
+        the aggregate as Prometheus text or JSON."""
+        if _metrics.ENABLED:
+            self.metrics_agg.ingest("head", _metrics.snapshot_delta())
+        if p.get("format") == "prometheus":
+            return {"text": self.metrics_agg.render_prometheus()}
+        return {"metrics": self.metrics_agg.to_json()}
+
     def rpc_autoscaler_state(self, p, conn):
         """Demand snapshot for the autoscaler (reference: the GCS-side demand
         the monitor polls — gcs_autoscaler_state_manager.cc in v2)."""
@@ -1638,6 +1722,7 @@ class GcsServer:
                 self._schedule_round()
             except Exception:
                 traceback.print_exc()
+                rpc_mod.flight_dump("gcs-sched-round-crash")
 
     def _intake_locked(self) -> List[tuple]:
         """Vet newly-submitted tasks ONCE (dup check, dead-actor drop, dep
@@ -1707,10 +1792,16 @@ class GcsServer:
         batched kernel call over per-class queue DEPTHS -> dispatch pushes.
         Work per round is O(classes + dispatched + new arrivals), never
         O(total queued)."""
+        t0 = time.perf_counter() if _metrics.ENABLED else 0.0
         pg_work: List[tuple] = []
         pipelined = getattr(self.policy, "pipelined", False)
         with self._lock:
             deps_lost_round = self._intake_locked()
+            if _metrics.ENABLED:
+                _M_SCHED_PENDING.set(
+                    sum(len(b["q"]) for b in self._class_buckets.values())
+                    + len(self._special_queue)
+                )
             have_work = bool(self._class_buckets) or bool(self._special_queue)
             if pipelined and self.policy.has_inflight():
                 have_work = True  # trailing pipeline rounds still flushing
@@ -1847,6 +1938,9 @@ class GcsServer:
                 self._push_conn(target, "task_result", payload)
         for t, lost in deps_lost_round:
             self._push_deps_lost(t, lost)
+        if _metrics.ENABLED:
+            _M_SCHED_ROUND.observe(time.perf_counter() - t0)
+            _M_DISPATCH_BATCH.observe(len(dispatches))
 
     def _schedule_special(self, t) -> Tuple[str, Any]:
         """NODE_AFFINITY and PLACEMENT_GROUP strategies (reference:
@@ -2111,6 +2205,9 @@ class GcsServer:
                          node_id=node_id, cause=cause)
             n["alive"] = False
             self.state.remove_node(node_id)
+            # retire the dead node's gauge series; its counters stay in
+            # the cumulative aggregate (delta-merge is restart-safe)
+            self.metrics_agg.drop_source(node_id)
             if rpc_mod.TRACE is not None:
                 rpc_mod.TRACE.apply("node_dead", node=node_id, cause=cause)
             lost_tasks = [

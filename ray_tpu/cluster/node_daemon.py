@@ -34,6 +34,41 @@ from ray_tpu.cluster.rpc import (
     RpcServer,
     log_rpc_failure,
 )
+from ray_tpu.util import metrics as _metrics
+
+# --- observability (ray_tpu.obs): daemon-side metrics, module-scope.
+# Handler self-time carries an explicit ``node`` tag so the cluster
+# aggregate keeps per-node attribution even in the embedded test topology
+# where several daemons share one process registry.
+_M_RPC_HANDLER = _metrics.Histogram(
+    "ray_tpu_daemon_rpc_handler_s",
+    "node-daemon rpc handler self-time per method",
+    boundaries=(
+        0.00001, 0.000025, 0.00005, 0.0001, 0.00025, 0.0005, 0.001,
+        0.0025, 0.005, 0.01, 0.025, 0.1, 0.5, 2.0,
+    ),
+    tag_keys=("method", "node"),
+)
+_M_STORE_BYTES = _metrics.Gauge(
+    "ray_tpu_object_store_bytes",
+    "bytes resident in the node-local object store",
+    tag_keys=("node",),
+)
+_M_STORE_SPILLED = _metrics.Gauge(
+    "ray_tpu_object_store_spilled_objects",
+    "objects spilled to disk by the node-local store",
+    tag_keys=("node",),
+)
+_M_TASK_QUEUE = _metrics.Gauge(
+    "ray_tpu_daemon_task_queue",
+    "dispatched tasks waiting for a free worker on this node",
+    tag_keys=("node",),
+)
+_M_IDLE_WORKERS = _metrics.Gauge(
+    "ray_tpu_daemon_idle_workers",
+    "idle pooled workers on this node",
+    tag_keys=("node",),
+)
 
 
 class ObjectStore:
@@ -224,6 +259,9 @@ class NodeDaemon:
             self.shm_name = None
 
         self._lock = threading.Lock()
+        # per-method handler-metric series keys for THIS node, built once
+        # (per-call tag-dict builds cost more than the observation)
+        self._m_handler_keys: Dict[str, tuple] = {}
         self.workers: Dict[str, _Worker] = {}
         self._idle: deque = deque()
         self._task_queue: deque = deque()  # tasks waiting for a worker
@@ -251,6 +289,16 @@ class NodeDaemon:
         # dying worker's borrows are released on its behalf (reference:
         # reference_count.cc removes borrower entries on worker death)
         self._worker_borrows: Dict[str, Dict[str, str]] = {}
+        # metric delta snapshots pushed by local workers (rpc_metrics_push),
+        # folded into this node's next heartbeat export; guarded by _lock
+        # (appended on the rpc loop, drained by the heartbeat thread).
+        # _metrics_seq stamps each metrics-carrying beat so the GCS can
+        # dedupe retry-plane resends of the same frame (heartbeat is in
+        # RETRYABLE); a beat that FAILS requeues its delta here — the
+        # deltas are stateful (each increment handed out exactly once by
+        # snapshot_delta), so dropping one would undercount forever.
+        self._worker_metrics: List[dict] = []
+        self._metrics_seq = 0
 
         self.server = RpcServer(
             self._handle, host=host, port=0,
@@ -502,7 +550,18 @@ class NodeDaemon:
         fn = getattr(self, f"rpc_{method}", None)
         if fn is None:
             raise ValueError(f"unknown daemon method {method}")
-        return fn(params or {}, conn)
+        if not _metrics.ENABLED:
+            return fn(params or {}, conn)
+        t0 = time.perf_counter()
+        try:
+            return fn(params or {}, conn)
+        finally:
+            k = self._m_handler_keys.get(method)
+            if k is None:
+                k = self._m_handler_keys[method] = \
+                    _M_RPC_HANDLER.series_key(
+                        {"method": method, "node": self.node_id})
+            _M_RPC_HANDLER.observe_k(k, time.perf_counter() - t0)
 
     def rpc_worker_ready(self, p, conn):
         worker_id = p["worker_id"]
@@ -720,6 +779,13 @@ class NodeDaemon:
             self._pending_rpc[p["task_id"]] = fut
         self._dispatch_actor_task(p)
         return fut
+
+    def rpc_metrics_push(self, p, conn):
+        """Worker -> daemon (notify): a worker process's metric registry
+        delta; queued here and folded into the node's next heartbeat
+        export (workers have no GCS connection of their own)."""
+        with self._lock:
+            self._worker_metrics.append(p["delta"])
 
     def rpc_stats(self, p, conn):
         with self._lock:
@@ -1505,10 +1571,45 @@ class NodeDaemon:
             if beats % 5 == 0:  # physical stats every ~5th beat (psutil
                 payload["stats"] = self._sample_stats()  # calls are cheap
             beats += 1                                   # but not free)
+            if _metrics.ENABLED:
+                # metric export rides the beat: this process's registry
+                # delta + any deltas local workers pushed since last time.
+                # Deltas partition the totals, so several in-process
+                # daemons (embedded test topology) exporting one shared
+                # registry never double-count (see util/metrics.py).
+                st = self.store.stats()
+                _M_STORE_BYTES.set(
+                    st.get("bytes_in_memory", 0), {"node": self.node_id}
+                )
+                _M_STORE_SPILLED.set(
+                    st.get("spilled", 0), {"node": self.node_id}
+                )
+                _M_TASK_QUEUE.set(
+                    len(self._task_queue), {"node": self.node_id}
+                )
+                _M_IDLE_WORKERS.set(
+                    len(self._idle), {"node": self.node_id}
+                )
+                delta = _metrics.snapshot_delta()
+                with self._lock:
+                    pushed, self._worker_metrics = self._worker_metrics, []
+                for d in pushed:
+                    _metrics.merge_deltas(delta, d)
+                if delta:
+                    self._metrics_seq += 1
+                    payload["metrics"] = delta
+                    payload["metrics_seq"] = self._metrics_seq
             try:
                 self.gcs.call("heartbeat", payload, timeout=5.0)
             except Exception:
-                pass
+                # the beat is lost but its DELTA must not be: requeue it
+                # for the next beat (at-least-once; the seq stamp dedupes
+                # exact resends server-side, and the only double-count
+                # window left is apply-then-lost-response)
+                delta = payload.get("metrics")
+                if delta:
+                    with self._lock:
+                        self._worker_metrics.append(delta)
             time.sleep(period)
 
     def _sample_stats(self) -> dict:

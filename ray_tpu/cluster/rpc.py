@@ -37,8 +37,41 @@ import traceback
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ray_tpu.core import config as _config
+from ray_tpu.util import metrics as _metrics
 
 _LEN = struct.Struct("<Q")
+
+# --- observability (ray_tpu.obs): client-side rpc metrics + retry-plane
+# counters. Constructed at module scope (one registry entry per process);
+# every observation site is gated on the single _metrics.ENABLED global.
+_M_CALL_LATENCY = _metrics.Histogram(
+    "ray_tpu_rpc_client_call_s",
+    "blocking rpc round-trip latency per method (client-side)",
+    tag_keys=("method",),
+)
+_M_CLIENT_PENDING = _metrics.Gauge(
+    "ray_tpu_rpc_client_pending",
+    "in-flight request futures on one rpc client connection",
+    tag_keys=("peer",),
+)
+_M_RECONNECTS = _metrics.Counter(
+    "ray_tpu_rpc_reconnects_total",
+    "successful RetryingRpcClient reconnections",
+    tag_keys=("peer",),
+)
+_M_RESENDS = _metrics.Counter(
+    "ray_tpu_rpc_resends_total",
+    "ack-watchdog resends of unanswered retryable call_asyncs",
+    tag_keys=("peer",),
+)
+_M_BLACKHOLES = _metrics.Counter(
+    "ray_tpu_rpc_blackhole_resets_total",
+    "connections reset after consecutive unanswered attempt windows",
+    tag_keys=("peer",),
+)
+# per-method/per-peer series keys, computed once (the per-call tag-dict
+# build + sort costs more than the observation itself on hot rpc paths)
+_CALL_LATENCY_KEYS: Dict[str, tuple] = {}
 MAX_FRAME = 1 << 31
 
 # Active fault plane, or None. Set ONLY by ray_tpu.chaos.install/uninstall;
@@ -81,6 +114,19 @@ def log_rpc_failure(fut):
 
 class ConnectionLost(RpcError):
     pass
+
+
+def flight_dump(reason: str) -> None:
+    """Best-effort black-box dump on a crash surface: when the active
+    tracer is the always-on flight recorder (ray_tpu.obs), write its ring
+    to artifacts/ (rate-limited). Never raises — a failing dump must not
+    compound the crash being recorded."""
+    t = TRACE
+    if t is not None and getattr(t, "is_flight_recorder", False):
+        try:
+            t.maybe_dump(reason)
+        except Exception:  # noqa: BLE001 - crash path stays quiet
+            pass
 
 
 async def read_frame(reader: asyncio.StreamReader) -> Any:
@@ -307,7 +353,10 @@ class RpcServer:
                     {"id": mid, "error": (type(e).__name__, str(e), traceback.format_exc())}
                 )
             else:
+                # a fire-and-forget handler crashed: nobody hears the
+                # error response that doesn't exist — leave a black box
                 traceback.print_exc()
+                flight_dump(f"handler-crash-{self.name}")
 
     def broadcast(self, channel: str, data: Any, filter_fn=None):
         """Thread-safe push to all (or filtered) connections."""
@@ -408,6 +457,7 @@ class RpcClient:
             struct.pack("ll", int(slice_s), int((slice_s % 1.0) * 1e6)),
         )
         self._send_lock = threading.Lock()
+        self._m_pending_key = _M_CLIENT_PENDING.series_key({"peer": peer})
         self._closed = False
         self.on_close: Optional[Callable] = None
         self._reader_thread = threading.Thread(
@@ -461,6 +511,10 @@ class RpcClient:
             return
         mid = msg.get("id")
         fut = self._pending.pop(mid, None)
+        if fut is not None and _metrics.ENABLED:
+            # keep the gauge honest on the way DOWN too, or an idle
+            # connection reports its burst high-water mark forever
+            _M_CLIENT_PENDING.set_k(self._m_pending_key, len(self._pending))
         if fut is not None and not fut.done():
             if "error" in msg:
                 etype, estr, tb = msg["error"]
@@ -526,6 +580,8 @@ class RpcClient:
             mid = self._next_id
         fut: Future = Future()
         self._pending[mid] = fut
+        if _metrics.ENABLED:
+            _M_CLIENT_PENDING.set_k(self._m_pending_key, len(self._pending))
         msg = {"id": mid, "method": method, "params": params}
         if TRACE is not None:
             msg["_lc"] = TRACE.on_send(self.name, self.peer, method)
@@ -554,11 +610,19 @@ class RpcClient:
         return fut
 
     def call(self, method: str, params: Any = None, timeout: Optional[float] = None):
+        t0 = time.perf_counter() if _metrics.ENABLED else 0.0
         fut = self.call_async(method, params)
         from concurrent.futures import TimeoutError as FutTimeout
 
         try:
-            return fut.result(timeout=timeout or self.timeout)
+            result = fut.result(timeout=timeout or self.timeout)
+            if _metrics.ENABLED:
+                k = _CALL_LATENCY_KEYS.get(method)
+                if k is None:
+                    k = _CALL_LATENCY_KEYS[method] = \
+                        _M_CALL_LATENCY.series_key({"method": method})
+                _M_CALL_LATENCY.observe_k(k, time.perf_counter() - t0)
+            return result
         except FutTimeout:
             # drop the orphaned future so _pending doesn't leak (a late
             # response finds no entry and is ignored)
@@ -630,7 +694,7 @@ class RetryingRpcClient:
         "available_resources", "summary", "autoscaler_state", "stats",
         "submit_task", "task_done", "actor_died", "register_borrows",
         "borrow_released", "free_objects", "stream_item", "stream_ack",
-        "worker_logs", "register_actor",
+        "worker_logs", "register_actor", "metrics",
         # PG ops are dedupe-guarded server-side (duplicate create returns
         # the current state; remove/kill are idempotent pops)
         "create_placement_group", "remove_placement_group", "kill_actor",
@@ -765,6 +829,8 @@ class RetryingRpcClient:
                     continue
                 with self._cv:
                     self._reconnecting = False
+                if _metrics.ENABLED:
+                    _M_RECONNECTS.inc(tags={"peer": self.peer})
                 self._publish(raw)
                 return
         finally:
@@ -851,6 +917,8 @@ class RetryingRpcClient:
                     with self._cv:
                         current = self._raw is raw
                     if current:
+                        if _metrics.ENABLED:
+                            _M_BLACKHOLES.inc(tags={"peer": self.peer})
                         raw._teardown()
                     stale_timeouts = 0
 
@@ -991,11 +1059,15 @@ class RetryingRpcClient:
                     fut.set_exception(RpcTimeout(
                         f"rpc {method} unacknowledged after resends"
                     ))
+            if _metrics.ENABLED and resend:
+                _M_RESENDS.inc(len(resend), tags={"peer": self.peer})
             for raw, fut, method, params in resend:
                 try:
                     self._chain(raw.call_async(method, params), fut)
                 except Exception:  # noqa: BLE001 - raced an outage
                     pass
+            if _metrics.ENABLED and suspect:
+                _M_BLACKHOLES.inc(len(suspect), tags={"peer": self.peer})
             for raw in suspect:
                 raw._teardown()
 
@@ -1062,3 +1134,16 @@ if os.environ.get("RAY_TPU_TRACE_FILE"):  # pragma: no cover - env-driven
         _inv.install_from_env()
 
     _install_trace_from_env()
+
+# Always-on flight recorder (ray_tpu.obs): when no file tracer claimed the
+# hook, install the bounded in-memory ring as the default TRACE so every
+# process keeps a dumpable black box of its recent protocol events. A
+# later invariants.install() displaces it for the session and
+# invariants.uninstall() restores it.
+if TRACE is None and _config.GLOBAL_CONFIG.flight_recorder_enabled:
+    def _install_flight_recorder():
+        from ray_tpu.obs.flightrec import install_default
+
+        install_default()
+
+    _install_flight_recorder()

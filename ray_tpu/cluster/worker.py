@@ -599,6 +599,28 @@ def _dag_loop(client: RpcClient, spec: dict):
                 pass
 
 
+def _metrics_push_loop(client: RpcClient):
+    """Periodic worker -> daemon metric export (ray_tpu.obs): the worker's
+    registry delta rides a fire-and-forget ``metrics_push``; the daemon
+    folds it into the node's next GCS heartbeat. Ends with the
+    connection."""
+    from ray_tpu.core import config as _config
+    from ray_tpu.util import metrics as _m
+
+    period = _config.GLOBAL_CONFIG.metrics_report_interval_ms / 1000.0
+    while True:
+        time.sleep(period)
+        if not _m.ENABLED:
+            continue
+        delta = _m.snapshot_delta()
+        if not delta:
+            continue
+        try:
+            client.notify("metrics_push", {"delta": delta})
+        except Exception:  # noqa: BLE001 - daemon gone; worker exits soon
+            return
+
+
 def _on_dag_loop(client: RpcClient):
     def handler(spec: dict):
         threading.Thread(
@@ -638,6 +660,10 @@ def main():  # pragma: no cover - runs as a subprocess
 
     ray_tpu.init(ignore_reinit_error=True)
     client.call("worker_ready", {"worker_id": worker_id}, timeout=30.0)
+    threading.Thread(
+        target=_metrics_push_loop, args=(client,), daemon=True,
+        name="worker-metrics-push",
+    ).start()
     # Threaded-actor pool (reference: max_concurrency>1): methods of an actor
     # created with max_concurrency>1 may overlap/block on each other.
     from concurrent.futures import ThreadPoolExecutor

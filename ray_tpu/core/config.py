@@ -84,6 +84,16 @@ _DEFS: Dict[str, tuple] = {
     "num_workers_soft_limit": (int, 0),  # 0 -> num_cpus
     "worker_start_timeout_s": (float, 30.0),
     "metrics_report_interval_ms": (float, 2000.0),
+    # --- observability (ray_tpu.obs; util/metrics.py pipeline) ---
+    # master switch for metric collection + the heartbeat delta export;
+    # instrumented hot paths check util.metrics.ENABLED (one global load)
+    "metrics_enabled": (bool, True),
+    # always-on in-memory flight recorder (ray_tpu/obs/flightrec.py):
+    # a bounded ring of the same events the ProtocolTracer emits, dumped
+    # to artifacts/flightrec-*.jsonl on crash surfaces; cheap enough to
+    # leave ON (preformatted tuples, no serialization until a dump)
+    "flight_recorder_enabled": (bool, True),
+    "flight_recorder_cap": (int, 4096),
     "log_to_driver": (bool, True),
     "session_dir_root": (str, "/tmp/ray_tpu"),
     # task-event log (reference: gcs_task_manager.cc

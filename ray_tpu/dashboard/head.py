@@ -92,7 +92,28 @@ class DashboardHead:
                 except OSError:
                     pass  # client hung up / head shutting down mid-request
 
+            def _respond_text(self, text, status,
+                              ctype="text/plain; version=0.0.4"):
+                # Prometheus exposition is text, not JSON (the version
+                # parameter is the text-format content type scrapers send)
+                try:
+                    data = text.encode()
+                    self.send_response(status)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                except OSError:
+                    pass
+
             def do_GET(self):
+                if self.path.partition("?")[0] == "/metrics":
+                    try:
+                        text, status = head._route_metrics_text()
+                    except Exception as e:  # noqa: BLE001
+                        text, status = repr(e) + "\n", 500
+                    self._respond_text(text, status)
+                    return
                 try:
                     body, status = head._route(self.path)
                 except Exception as e:  # noqa: BLE001
@@ -141,11 +162,16 @@ class DashboardHead:
                     "/api/tasks?limit=N", "/api/placement_groups",
                     "/api/cluster_resources", "/api/available_resources",
                     "/api/events?limit=N&severity=&label=",
+                    "/api/metrics", "/metrics (Prometheus text)",
                     "/api/jobs [GET|POST]", "/api/jobs/<id>",
                     "/api/jobs/<id>/logs", "/api/jobs/<id>/stop [POST]",
                     "/api/call [POST]",
                 ]
             }, 200
+        if route == "/api/metrics":
+            r = self._client.gcs.call("metrics", {"format": "json"},
+                                      timeout=15.0)
+            return r["metrics"], 200
         if route == "/api/summary":
             return c.summary(), 200
         if route == "/api/nodes":
@@ -206,6 +232,15 @@ class DashboardHead:
                 return {"error": f"no job {jid}"}, 404
             return self._job_view(j), 200
         return {"error": f"unknown route {route}"}, 404
+
+    def _route_metrics_text(self):
+        """GET /metrics: the GCS's cluster-wide aggregate in Prometheus
+        text format (reference: dashboard/modules/metrics exposing the
+        scrape endpoint) — node heartbeat deltas + the head's own
+        registry, see util/metrics.py."""
+        r = self._client.gcs.call("metrics", {"format": "prometheus"},
+                                  timeout=15.0)
+        return r["text"], 200
 
     # ------------------------------------------------------------- POST
 

@@ -227,6 +227,35 @@ totals = [ray_tpu.get(a.add.remote(0), timeout=60) for a in actors]
 print("actor totals:", totals, flush=True)
 print("fault trace (%d faults):" % len(sched.trace()), flush=True)
 print(sched.trace_text(), flush=True)
+
+# final metrics snapshot (ray_tpu.obs): printed + written to artifacts/ so
+# soak regressions (latency shifts, retry storms) are diffable across runs
+from ray_tpu.util import metrics as _metrics
+
+_prom = _metrics.export_prometheus()
+_metrics_path = None
+try:
+    import os as _os2
+
+    _os2.makedirs("artifacts", exist_ok=True)
+    _metrics_path = _os2.path.join(
+        "artifacts", "chaos_soak_metrics_seed%d.prom" % args.seed
+    )
+    with open(_metrics_path, "w") as _f:
+        _f.write(_prom)
+except OSError:
+    pass
+print("metrics snapshot (%d series lines -> %s):" % (
+    sum(1 for ln in _prom.splitlines() if ln and not ln.startswith("#")),
+    _metrics_path,
+), flush=True)
+print("\n".join(
+    ln for ln in _prom.splitlines()
+    if ln.startswith(("ray_tpu_rpc_reconnects", "ray_tpu_rpc_resends",
+                      "ray_tpu_rpc_blackhole", "ray_tpu_gcs_sched_round_s_c",
+                      "ray_tpu_client_tasks_submitted"))
+), flush=True)
+
 ray_tpu.shutdown(); cluster.shutdown(); chaos.uninstall()
 invariants.uninstall()
 violations = invariants.check_trace(trace_path)
@@ -244,5 +273,14 @@ pairs = interleaving_coverage(invariants.read_trace(trace_path))
 print("interleaving coverage: %d distinct handler-pair orderings "
       "observed at the GCS" % len(pairs), flush=True)
 print("SOAK DONE; task errors:", stats["errors"], flush=True)
+if violations or stats["errors"]:
+    # leave a black box in the standard flightrec artifact location: the
+    # soak ran under the file tracer (which displaced the in-memory
+    # recorder), so the artifact is the trace TAIL in the same
+    # --check-trace format a production recorder dump would have
+    from ray_tpu.obs import save_trace_tail
+
+    print("flight-recorder black box:",
+          save_trace_tail(trace_path, "chaos-soak-error"), flush=True)
 if violations:
     raise SystemExit(1)

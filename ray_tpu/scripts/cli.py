@@ -1,9 +1,11 @@
-"""CLI: start/stop/status/list/summary/timeline/memory/microbenchmark.
+"""CLI: start/stop/status/list/summary/timeline/memory/metrics/
+microbenchmark.
 
 Reference: python/ray/scripts/scripts.py (`ray start --head`,
 `ray start --address`, `ray stop`, `ray status`, `ray list ...`,
-`ray summary`, `ray timeline`, `ray memory`, `ray microbenchmark`).
-Invoke as ``python -m ray_tpu <command>``.
+`ray summary`, `ray timeline`, `ray memory`, `ray microbenchmark`) plus
+the dashboard metrics view (`ray_tpu metrics` / `--top` / `--prom`, see
+ray_tpu.obs). Invoke as ``python -m ray_tpu <command>``.
 """
 
 from __future__ import annotations
@@ -195,6 +197,46 @@ def cmd_microbenchmark(args):
     perf_main(address=getattr(args, "address", None), quick=args.quick)
 
 
+def cmd_metrics(args):
+    """Cluster-aggregated metrics view (ray_tpu.obs). Default: compact
+    counter/gauge summary. ``--top``: rank GCS/daemon rpc-handler
+    self-time — where the per-task control-plane milliseconds go.
+    ``--prom``: raw Prometheus text (what the dashboard's /metrics
+    serves)."""
+    from ray_tpu.cluster.rpc import RpcClient
+
+    host, _, port = _resolve_address(args).rpartition(":")
+    c = RpcClient(host, int(port), name="cli-metrics", peer="gcs")
+    try:
+        if args.prom:
+            print(c.call("metrics", {"format": "prometheus"},
+                         timeout=15.0)["text"], end="")
+            return
+        agg = c.call("metrics", {"format": "json"}, timeout=15.0)["metrics"]
+        if args.top:
+            from ray_tpu.obs import rank_handler_time
+
+            rows = rank_handler_time(agg, limit=args.limit)
+            print(f"{'surface':<8}{'method':<28}{'node':<16}"
+                  f"{'calls':>8}{'total_s':>10}{'mean_us':>10}")
+            for r in rows:
+                print(f"{r['surface']:<8}{r['method']:<28}"
+                      f"{r['node'][:15]:<16}{r['calls']:>8}"
+                      f"{r['total_s']:>10.4f}{r['mean_us']:>10.1f}")
+            return
+        for name in sorted(agg):
+            m = agg[name]
+            if m["kind"] == "histogram":
+                total = sum(s.get("count", 0) for s in m["series"])
+                hsum = sum(s.get("sum", 0.0) for s in m["series"])
+                print(f"{name:<44}{m['kind']:<10}n={total} sum={hsum:.4f}s")
+            else:
+                val = sum(s.get("value", 0.0) for s in m["series"])
+                print(f"{name:<44}{m['kind']:<10}{val:g}")
+    finally:
+        c.close()
+
+
 def cmd_dashboard(args):
     import time as _time
 
@@ -313,6 +355,17 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--address")
     sp.add_argument("--limit", type=int, default=1000)
     sp.set_defaults(fn=cmd_list)
+
+    sp = sub.add_parser(
+        "metrics", help="cluster metrics: summary, --top handler ranking, "
+        "--prom Prometheus text")
+    sp.add_argument("--address")
+    sp.add_argument("--top", action="store_true",
+                    help="rank rpc handler self-time (GCS + daemons)")
+    sp.add_argument("--prom", action="store_true",
+                    help="print raw Prometheus exposition text")
+    sp.add_argument("--limit", type=int, default=20)
+    sp.set_defaults(fn=cmd_metrics)
 
     sp = sub.add_parser("microbenchmark", help="single-node perf quick check")
     sp.add_argument("--address")

@@ -177,12 +177,17 @@ def main(argv=None) -> int:
         print(f"explore: {total} schedules across "
               f"{len(report)} scenarios, 0 violations")
 
-    # (5) tier-1 with per-test durations as a CI artifact
+    # (5) tier-1 with per-test durations as a CI artifact. The pytest
+    # process writes a final metrics snapshot at exit (util/metrics.py
+    # RAY_TPU_METRICS_DUMP hook) so control-plane regressions — handler
+    # latency shifts, retry storms — are diffable across CI runs.
     if args.tier1:
         art = os.path.join(args.artifact_dir, "tier1_durations.txt")
+        metrics_art = os.path.join(args.artifact_dir, "tier1_metrics.prom")
+        env = dict(os.environ, RAY_TPU_METRICS_DUMP=metrics_art)
         with open(art, "w") as f:
             proc = subprocess.Popen(
-                ["bash", "-c", TIER1_CMD], cwd=REPO,
+                ["bash", "-c", TIER1_CMD], cwd=REPO, env=env,
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             )
             for line in proc.stdout:
@@ -190,6 +195,17 @@ def main(argv=None) -> int:
                 f.write(line)
             rc = proc.wait()
         print(f"tier-1 durations artifact: {art}")
+        if os.path.exists(metrics_art):
+            with open(metrics_art) as f:
+                lines = f.read().splitlines()
+            series = [ln for ln in lines
+                      if ln and not ln.startswith("#")]
+            print(f"tier-1 metrics snapshot: {metrics_art} "
+                  f"({len(series)} series); handler totals:")
+            for ln in series:
+                if "_rpc_handler_s_count" in ln or ln.startswith(
+                        ("ray_tpu_rpc_reconnects", "ray_tpu_rpc_resends")):
+                    print("  " + ln)
         if rc != 0:
             print(f"lint_gate: tier-1 run failed (rc={rc})", file=sys.stderr)
             return 1

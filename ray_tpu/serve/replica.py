@@ -20,6 +20,16 @@ import threading
 from typing import Any, Dict, Optional
 
 import ray_tpu
+from ray_tpu.util import metrics as _metrics
+
+# observability (ray_tpu.obs): replica queue depth, exported on the same
+# side-thread cadence as the autoscaling stats push (never on the
+# request path) and tagged per deployment
+_M_REPLICA_ONGOING = _metrics.Gauge(
+    "ray_tpu_serve_replica_ongoing",
+    "in-flight requests on serve replicas (summed per deployment)",
+    tag_keys=("deployment",),
+)
 
 
 @ray_tpu.remote
@@ -87,6 +97,10 @@ class ServeReplica:
                 if ctrl is None:
                     ctrl = _rt.get_actor("serve:controller")
                 ongoing = self._ongoing
+                if _metrics.ENABLED:
+                    _M_REPLICA_ONGOING.set(
+                        ongoing, {"deployment": str(self._identity[0])}
+                    )
                 # fire-and-forget metrics push; a lost sample is harmless
                 # and the next tick re-reports
                 ctrl.record_stats.remote(list(self._identity), ongoing)  # ray-lint: disable=dropped-object-ref

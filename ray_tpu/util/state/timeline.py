@@ -2,15 +2,18 @@
 
 Reference: `ray timeline` (python/ray/scripts/scripts.py timeline command)
 — task events rendered in the chrome://tracing / Perfetto "trace events"
-JSON format, one row per node/actor lane.
+JSON format, one row per node/actor lane. Event rendering goes through
+the shared renderer in util/chrome_trace.py (the same one driver-side
+spans use), so `ray_tpu timeline` output and `export_chrome_trace` files
+concatenate into a single coherent view.
 """
 
 from __future__ import annotations
 
-import json
 from typing import List, Optional
 
 from ray_tpu.core import api as _api
+from ray_tpu.util.chrome_trace import complete_event, write_trace
 
 
 def chrome_trace(events: Optional[List[dict]] = None) -> List[dict]:
@@ -28,24 +31,16 @@ def chrome_trace(events: Optional[List[dict]] = None) -> List[dict]:
         # of disappearing into one "tasks" lane
         lane = (e.get("actor_id") or e.get("stage") or e.get("worker_id")
                 or "tasks")
-        trace.append({
-            "name": e.get("name") or e.get("task_id", "task"),
-            "cat": "dag_stage" if e.get("stage")
+        trace.append(complete_event(
+            e.get("name") or e.get("task_id", "task"), start, end,
+            pid=e.get("node") or e.get("node_id") or "node",
+            tid=lane,
+            cat="dag_stage" if e.get("stage")
             else "actor_task" if e.get("actor_id") else "task",
-            "ph": "X",
-            "ts": start * 1e6,  # chrome trace wants microseconds
-            "dur": max((end - start) * 1e6, 1.0),
-            "pid": e.get("node") or e.get("node_id") or "node",
-            "tid": lane,
-            "args": {
-                "task_id": e.get("task_id"),
-                "status": e.get("status"),
-            },
-        })
+            args={"task_id": e.get("task_id"), "status": e.get("status")},
+        ))
     return trace
 
 
 def dump_timeline(path: str, events: Optional[List[dict]] = None) -> str:
-    with open(path, "w") as f:
-        json.dump(chrome_trace(events), f)
-    return path
+    return write_trace(path, chrome_trace(events))

@@ -15,7 +15,6 @@ when RAY_TRACING_ENABLED) and the dashboard's profiling hooks. Two pieces:
 from __future__ import annotations
 
 import contextlib
-import json
 import os
 import threading
 import time
@@ -37,12 +36,13 @@ def tracing_enabled() -> bool:
 
 
 def record_span(name: str, start: float, end: float, **meta) -> None:
+    from ray_tpu.util.chrome_trace import complete_event
+
     with _lock:
-        _spans.append({
-            "name": name, "ph": "X", "pid": os.getpid(),
-            "tid": threading.get_ident() % 1_000_000,
-            "ts": start * 1e6, "dur": (end - start) * 1e6, "args": meta,
-        })
+        _spans.append(complete_event(
+            name, start, end, pid=os.getpid(),
+            tid=threading.get_ident() % 1_000_000, cat="driver", args=meta,
+        ))
 
 
 def get_spans() -> List[Dict[str, Any]]:
@@ -57,11 +57,11 @@ def clear_spans() -> None:
 
 def export_chrome_trace(path: str) -> str:
     """Write collected spans as a chrome://tracing JSON array — the SAME
-    top-level shape `ray_tpu timeline` emits (util/state/timeline.py), so
-    the two files merge by list concatenation."""
-    with open(path, "w") as f:
-        json.dump(get_spans(), f)
-    return path
+    renderer `ray_tpu timeline` uses (util/chrome_trace.py), so the two
+    files merge by list concatenation into one coherent view."""
+    from ray_tpu.util.chrome_trace import write_trace
+
+    return write_trace(path, get_spans())
 
 
 def enable_task_spans() -> None:

@@ -62,10 +62,18 @@ def invariant_sanitizer(tmp_path):
     finally:
         invariants.uninstall()
         violations = invariants.check_trace(trace_path)
-        assert not violations, (
-            "protocol invariant violation(s):\n"
-            + "\n".join(v.format() for v in violations)
-        )
+        if violations:
+            # leave a black box beside the failure: the violating run was
+            # file-traced (the recorder was displaced for its duration),
+            # so the artifact is the trace TAIL in flightrec format
+            from ray_tpu.obs import save_trace_tail
+
+            dump = save_trace_tail(trace_path, "invariant-violation")
+            assert not violations, (
+                "protocol invariant violation(s):\n"
+                + "\n".join(v.format() for v in violations)
+                + f"\n(full trace: {trace_path}; black box: {dump})"
+            )
 
 
 @pytest.fixture

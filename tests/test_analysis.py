@@ -1856,15 +1856,22 @@ def test_invariants_object_lifecycle():
 # ===================================================== tracer plumbing
 
 
-def test_trace_hook_disabled_by_default_and_zero_cost(tmp_path):
+def test_trace_hook_default_recorder_displaced_and_restored(tmp_path):
+    """The default TRACE plane is the always-on flight recorder
+    (ray_tpu.obs); an opt-in file tracer displaces it for the session and
+    uninstall() puts it back (and is a no-op when nothing is installed)."""
     from ray_tpu.analysis import invariants
     from ray_tpu.cluster import rpc
 
-    assert rpc.TRACE is None  # default state
+    default = rpc.TRACE
+    assert default is not None and getattr(default, "is_flight_recorder",
+                                           False)
     tracer = invariants.install(str(tmp_path / "t.jsonl"))
     assert invariants.active() is tracer
     invariants.uninstall()
-    assert rpc.TRACE is None and tracer.closed
+    assert rpc.TRACE is default and tracer.closed
+    invariants.uninstall()  # idempotent: never closes/evicts the recorder
+    assert rpc.TRACE is default
 
 
 def test_tracer_records_sends_recvs_and_applies_with_clock(tmp_path):
