@@ -758,6 +758,66 @@ def explore_bench(budget=1400, samples=800):
     }
 
 
+def memmodel_bench(budget=2000, samples=400):
+    """Schedules/second through the word-level channel model checker
+    (analysis/memmodel.py): every schedule is a fresh virtual channel
+    world executed op by op with the word-level invariants checked
+    inline. Also reports total word ops covered, DFS branches pruned by
+    the rw-aware persistent-set filter, kill crash points exercised, and
+    the detection cost of both seeded channel bugs (schedules to find +
+    shrunk replay size — the budget headroom the lint_gate --memmodel
+    teeth rely on). Run: `python bench.py memmodel` (recorded as
+    BENCH_memmodel_rNN.json)."""
+    import time as _t
+
+    from ray_tpu.analysis import memmodel as _mm
+
+    per = {}
+    t0 = _t.perf_counter()
+    total = ops = pruned = crash = 0
+    for name in sorted(_mm.CHANNEL_SCENARIOS):
+        r = _mm.explore_channel(
+            _mm.CHANNEL_SCENARIOS[name], max_schedules=budget,
+            samples=samples,
+        )
+        assert not r.found, (name, r.violating and r.violating.violations)
+        per[name] = {
+            "schedules": r.schedules_run,
+            "ops": r.ops_covered,
+            "pruned": r.branches_pruned,
+            "crash_points": len(r.crash_points),
+            "elapsed_s": round(r.elapsed_s, 3),
+            "schedules_per_sec": round(r.schedules_run / r.elapsed_s, 1),
+        }
+        total += r.schedules_run
+        ops += r.ops_covered
+        pruned += r.branches_pruned
+        crash += len(r.crash_points)
+    seeded = {}
+    for bug, scen in _mm.SEEDED_BUG_SCENARIOS:
+        r = _mm.explore_channel(
+            _mm.CHANNEL_SCENARIOS[scen], max_schedules=budget, samples=0,
+            seeded_bugs=[bug],
+        )
+        assert r.found and r.shrunk is not None, bug
+        seeded[bug] = {
+            "scenario": scen,
+            "schedules_to_find": r.schedules_run,
+            "shrunk_ops": len(r.shrunk),
+        }
+    elapsed = _t.perf_counter() - t0
+    return {
+        "schedules": total,
+        "schedules_per_sec": round(total / elapsed, 1),
+        "ops_covered": ops,
+        "branches_pruned": pruned,
+        "crash_points": crash,
+        "elapsed_s": round(elapsed, 2),
+        "seeded": seeded,
+        "scenarios": per,
+    }
+
+
 def dag_loop_bench(n_stages=3, iters=None, remote_iters=40):
     """Compiled-graph hot loop vs the equivalent `.remote()` chain on a
     3-stage local-cluster pipeline (the ISSUE-4 acceptance metric): the
@@ -1011,6 +1071,21 @@ def main():
             "unit": "schedules/s (full scenario library, fresh world "
                     "per schedule, invariant-checked)",
             "configs": {"explore": r},
+        }))
+        return
+
+    if sys.argv[1:] == ["memmodel"]:
+        # word-level channel model checker microbench: pure host python
+        # — prints one JSON line (recorded as BENCH_memmodel_rNN)
+        r = memmodel_bench()
+        log(f"memmodel {r['schedules']} schedules / {r['ops_covered']} "
+            f"ops in {r['elapsed_s']}s, {r['crash_points']} crash points")
+        print(json.dumps({
+            "metric": "memmodel_schedules_per_sec",
+            "value": r["schedules_per_sec"],
+            "unit": "schedules/s (channel scenario library, fresh "
+                    "virtual channel per schedule, word-level invariants)",
+            "configs": {"memmodel": r},
         }))
         return
 

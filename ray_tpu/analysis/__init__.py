@@ -25,6 +25,11 @@ handler object under a virtual runtime and *searches* handler
 interleavings (bounded DFS + pruning + seeded sampling), replaying each
 schedule through the invariant checker; ``--explore`` / ``--replay`` on
 the CLI, budgeted in CI via ``scripts/lint_gate.py --explore``.
+:mod:`ray_tpu.analysis.memmodel` gives the compiled-DAG seqlock channel
+the same treatment at word-operation granularity (``--memmodel``,
+``lint_gate --memmodel``), kept honest by an op-sequence round-trip
+gate against ``dag/channel.py`` plus the two ``chan-*`` checkers
+(raw-header-access discipline, publication order).
 
 Deliberately imports no runtime module (jax, numpy, the cluster stack):
 linting must work in any environment the source parses in.

@@ -51,6 +51,13 @@ def main(argv=None) -> int:
                         "plane: explore handler interleavings of one "
                         "scenario (or 'all') through the invariant "
                         "checker; exit 1 on any violation")
+    p.add_argument("--memmodel", default=None, metavar="SCENARIO",
+                   nargs="?", const="all",
+                   help="instead of linting, model-check the compiled-"
+                        "dag seqlock channel at word-op granularity: "
+                        "explore writer/reader/poker interleavings of "
+                        "one channel scenario (or 'all'), kill-at-any-op "
+                        "included; exit 1 on any violation")
     p.add_argument("--list-scenarios", action="store_true")
     p.add_argument("--budget", type=int, default=500,
                    help="DFS schedule budget per scenario (default 500)")
@@ -66,8 +73,9 @@ def main(argv=None) -> int:
                    help="wall-clock cap in seconds per scenario")
     p.add_argument("--seed-bug", action="append", default=[],
                    metavar="NAME",
-                   help="re-introduce a known fixed bug (gcs.SEEDED_BUGS) "
-                        "for the exploration — the regression harness")
+                   help="re-introduce a known fixed bug (gcs.SEEDED_BUGS "
+                        "for --explore, channel.SEEDED_BUGS for "
+                        "--memmodel) — the regression harness")
     p.add_argument("--save-replay", default=None, metavar="FILE",
                    help="write the first (shrunk) counterexample here")
     p.add_argument("--replay", default=None, metavar="FILE",
@@ -85,16 +93,38 @@ def main(argv=None) -> int:
 
     if args.list_scenarios:
         from ray_tpu.analysis.explore import SCENARIOS
+        from ray_tpu.analysis.memmodel import CHANNEL_SCENARIOS
 
         for name in sorted(SCENARIOS):
             print(f"{name}: {SCENARIOS[name].description}")
+        for name in sorted(CHANNEL_SCENARIOS):
+            print(f"memmodel:{name}: "
+                  f"{CHANNEL_SCENARIOS[name].description}")
         return 0
 
     if args.replay is not None:
-        from ray_tpu.analysis import explore as _explore
-
+        # memmodel replays carry "kind": "memmodel"; explore replays
+        # predate the field — dispatch on it
         try:
-            res = _explore.replay(args.replay)
+            with open(args.replay, "r", encoding="utf-8") as f:
+                rec = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        if not isinstance(rec, dict):
+            print(f"error: {args.replay} is not a replay object",
+                  file=sys.stderr)
+            return 2
+        kind = rec.get("kind")
+        try:
+            if kind == "memmodel":
+                from ray_tpu.analysis import memmodel as _memmodel
+
+                res = _memmodel.replay_channel(args.replay)
+            else:
+                from ray_tpu.analysis import explore as _explore
+
+                res = _explore.replay(args.replay)
         except (OSError, ValueError, KeyError) as e:
             print(f"error: {e}", file=sys.stderr)
             return 2
@@ -105,6 +135,53 @@ def main(argv=None) -> int:
             print(v.format())
         print(f"{len(res.violations)} violation(s)")
         return 1 if res.violations else 0
+
+    if args.memmodel is not None:
+        from ray_tpu.analysis import memmodel as _memmodel
+
+        # accept the "memmodel:NAME" spelling --list-scenarios prints
+        requested = args.memmodel.split("memmodel:", 1)[-1]
+        names = (
+            sorted(_memmodel.CHANNEL_SCENARIOS) if requested == "all"
+            else [requested]
+        )
+        unknown = [n for n in names
+                   if n not in _memmodel.CHANNEL_SCENARIOS]
+        if unknown:
+            print(f"error: unknown channel scenario(s) {unknown}; have "
+                  f"{sorted(_memmodel.CHANNEL_SCENARIOS)}",
+                  file=sys.stderr)
+            return 2
+        problems = _memmodel.verify_op_sequences()
+        for msg in problems:
+            print(f"round-trip: {msg}", file=sys.stderr)
+        failed = bool(problems)
+        for name in names:
+            res = _memmodel.explore_channel(
+                _memmodel.CHANNEL_SCENARIOS[name],
+                max_schedules=args.budget,
+                samples=args.samples,
+                max_depth=args.depth,
+                seed=args.seed,
+                seeded_bugs=args.seed_bug,
+                wall_cap_s=args.wall_cap,
+            )
+            print(res.summary())
+            if res.found:
+                failed = True
+                for v in (res.shrunk_violations
+                          or res.violating.violations):
+                    print("  " + v.format())
+                print("  minimal schedule:")
+                for step in (res.shrunk or res.violating.schedule):
+                    print(f"    {step}")
+                if args.save_replay:
+                    _memmodel.write_channel_replay(
+                        args.save_replay, res, seeded_bugs=args.seed_bug
+                    )
+                    print(f"  replay written to {args.save_replay} "
+                          "(re-run with --replay)")
+        return 1 if failed else 0
 
     if args.explore is not None:
         from ray_tpu.analysis import explore as _explore

@@ -24,6 +24,7 @@ from ray_tpu.analysis.core import (
     Checker,
     Finding,
     ModuleContext,
+    chan_word_of,
     find_cycles,
     register,
 )
@@ -1680,6 +1681,153 @@ class MetricNameChecker(Checker):
                 "construct metrics at module//__init__ scope and observe "
                 "per call, or each call leaks a registry entry",
             ))
+
+
+# ------------------------------------------------------- channel memory
+#
+# Access-discipline checkers for the dag seqlock channel (the static
+# half of analysis/memmodel.py): the word-level model checker is only
+# sound while ALL header/payload access funnels through the ChannelMem
+# ops layer and the publication order the model verified is the order
+# the code ships. Scoped to dag/ and object_store/ — the two subsystems
+# built on (or absorbing) the channel's mmap machinery.
+
+_CHAN_SCOPE_DIRS = ("dag", "object_store")
+_MMAP_NAMES = ("mm", "_mm")
+
+
+def _in_channel_scope(relpath: str) -> bool:
+    parts = relpath.replace("\\", "/").split("/")
+    return any(p in _CHAN_SCOPE_DIRS for p in parts[:-1])
+
+
+@register
+class ChanRawHeaderAccessChecker(Checker):
+    """Any raw access to seqlock channel memory — ``struct``
+    ``pack_into``/``unpack_from``, an ``mmap.mmap`` construction, or
+    indexing an ``mm``/``_mm`` mapping — outside a ``*Mem`` ops-layer
+    class. The memmodel checker verifies the protocol through the
+    :class:`~ray_tpu.dag.channel.ChannelMem` seam; a header word poked
+    anywhere else is invisible to it (and to the AST round-trip gate),
+    so the model silently stops covering the shipped code."""
+
+    name = "chan-raw-header-access"
+    description = (
+        "raw channel header/payload access (struct pack/unpack, "
+        "mmap.mmap, mm[...] indexing) outside the ChannelMem ops layer "
+        "in dag//object_store/"
+    )
+
+    def check_module(self, ctx: ModuleContext) -> List[Finding]:
+        if not _in_channel_scope(ctx.relpath):
+            return []
+        imap = ImportMap(ctx.tree)
+        out: List[Finding] = []
+
+        def flag(node: ast.AST, what: str) -> None:
+            out.append(ctx.finding(
+                node, self.name,
+                f"{what} outside a *Mem ops-layer class: every channel "
+                "header-word/payload access must go through ChannelMem "
+                "(dag/channel.py) so the memmodel checker keeps covering "
+                "the real protocol",
+            ))
+
+        def visit(node: ast.AST, in_mem_class: bool) -> None:
+            if isinstance(node, ast.ClassDef):
+                in_mem_class = in_mem_class or node.name.endswith("Mem")
+            elif not in_mem_class:
+                if isinstance(node, ast.Call):
+                    if isinstance(node.func, ast.Attribute) and \
+                            node.func.attr in ("pack_into", "unpack_from"):
+                        flag(node, f"struct .{node.func.attr}()")
+                    elif imap.resolve(node.func) == "mmap.mmap":
+                        flag(node, "mmap.mmap() construction")
+                elif isinstance(node, ast.Subscript):
+                    v = node.value
+                    nm = v.attr if isinstance(v, ast.Attribute) else (
+                        v.id if isinstance(v, ast.Name) else None
+                    )
+                    if nm in _MMAP_NAMES:
+                        flag(node, f"`{nm}[...]` mapping access")
+            for child in ast.iter_child_nodes(node):
+                visit(child, in_mem_class)
+
+        visit(ctx.tree, False)
+        return out
+
+
+@register
+class ChanPublicationOrderChecker(Checker):
+    """Seqlock publication order, statically enforced where the channel
+    protocol is implemented (dag//object_store/): within one function,
+    the payload store must precede the ``version`` bump (the commit a
+    reader wakes on) and the payload copy must precede the ``ack``
+    advance (which frees the writer to overwrite). The memmodel checker
+    proved the inverted orders lose: a reader woken by an early
+    ``version`` copies torn/stale bytes (seeded bug
+    ``version-before-payload``); an early ``ack`` lets the writer
+    overwrite mid-copy."""
+
+    name = "chan-publication-order"
+    description = (
+        "channel `version`/`ack` published before the payload "
+        "store/copy it guards (seqlock commit-order inversion)"
+    )
+
+    #: method attrs that move payload bytes (the ChannelMem seam ops and
+    #: their raw struct-era spellings)
+    _PAYLOAD_WRITES = ("write_payload",)
+    _PAYLOAD_READS = ("read_payload",)
+    #: method attrs that store a header word (first arg names the word)
+    _WORD_STORES = ("_put", "store")
+
+    def check_module(self, ctx: ModuleContext) -> List[Finding]:
+        if not _in_channel_scope(ctx.relpath):
+            return []
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._check_function(ctx, node, out)
+        return out
+
+    def _check_function(self, ctx: ModuleContext, fn: ast.AST,
+                        out: List[Finding]) -> None:
+        payload_writes: List[int] = []
+        payload_reads: List[int] = []
+        word_stores: List[Tuple[str, ast.Call]] = []
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            attr = node.func.attr
+            if attr in self._PAYLOAD_WRITES:
+                payload_writes.append(node.lineno)
+            elif attr in self._PAYLOAD_READS:
+                payload_reads.append(node.lineno)
+            elif attr in self._WORD_STORES and node.args:
+                word = chan_word_of(node.args[0])
+                if word in ("version", "ack"):
+                    word_stores.append((word, node))
+        for word, call in word_stores:
+            if word == "version" and any(
+                line > call.lineno for line in payload_writes
+            ):
+                out.append(ctx.finding(
+                    call, self.name,
+                    "`version` published before the payload store: a "
+                    "reader woken by this bump copies torn/stale bytes "
+                    "— commit order is payload, len, THEN version",
+                ))
+            elif word == "ack" and any(
+                line > call.lineno for line in payload_reads
+            ):
+                out.append(ctx.finding(
+                    call, self.name,
+                    "`ack` advanced before the payload copy: the writer "
+                    "is freed to overwrite the frame mid-copy — copy "
+                    "the payload, THEN advance ack",
+                ))
 
 
 def static_lock_graph(paths, root=None):

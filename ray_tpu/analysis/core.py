@@ -177,6 +177,18 @@ def register(cls):
     return cls
 
 
+def chan_word_of(node: ast.AST) -> Optional[str]:
+    """Layout name of a channel-header word constant (``_W_VERSION`` /
+    ``W_CAP`` -> "version" / "capacity"), else None. The ONE recognizer
+    shared by the chan-publication-order checker and memmodel's
+    op-sequence extraction — two copies would let the lint and the
+    round-trip gate diverge on what counts as a word reference."""
+    if isinstance(node, ast.Name) and node.id.startswith(("_W_", "W_")):
+        name = node.id.split("W_", 1)[1].lower()
+        return {"cap": "capacity"}.get(name, name)
+    return None
+
+
 # ----------------------------------------------------------------------- graphs
 
 

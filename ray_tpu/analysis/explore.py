@@ -1125,7 +1125,16 @@ class ExploreResult:
         return head + f" — VIOLATION {kinds}, shrunk to {n} steps"
 
 
-# ------------------------------------------------------------- exploring
+# ----------------------------------------------------- generic engine
+#
+# The DFS + conflict-pruning + shrink machinery below is deliberately
+# generic over a duck-typed *run result* (needs: ``schedule``,
+# ``options_at``, ``keys_of``, ``violations`` with ``.kind``,
+# ``violation_kinds``) so other model checkers — analysis/memmodel.py's
+# word-level channel checker — reuse the exact same engine instead of
+# re-implementing (and diverging on) persistent-set pruning and
+# delta-debug shrinking. ``explore()``/``shrink_schedule()`` are the
+# GCS-scenario instantiations.
 
 
 def _conflicts(a: FrozenSet[str], b: FrozenSet[str]) -> bool:
@@ -1133,15 +1142,37 @@ def _conflicts(a: FrozenSet[str], b: FrozenSet[str]) -> bool:
 
 
 def _backtrack_alternatives(
-    res: WorldResult, start: int, max_depth: Optional[int]
+    res, start: int, max_depth: Optional[int],
+    conflicts: Callable[[FrozenSet[str], FrozenSet[str]], bool] = _conflicts,
+    process_of: Optional[Callable[[str], str]] = None,
 ) -> List[Tuple[int, str]]:
     """(position, alternative) pairs worth branching on, persistent-set
     style: an unchosen enabled step is explored at position i only when
-    something that ran in [i, its own turn) conflicts with it."""
+    something that ran in [i, its own turn) conflicts with it.
+
+    With ``process_of`` (worlds whose steps are *per-process program
+    counters*, e.g. memmodel's actor ops), the Flanagan–Godefroid
+    refinement also branches an alternative whose own op commutes but
+    whose process has a LATER op conflicting with something that ran in
+    between — without it, a benign leading op (a load of an untouched
+    word) shields its process's entire remaining schedule from DFS."""
     out: List[Tuple[int, str]] = []
     sched = res.schedule
     limit = len(sched) if max_depth is None else min(len(sched), max_depth)
     pos_of = {label: i for i, label in enumerate(sched)}
+    later_union: List[Dict[str, FrozenSet[str]]] = []
+    if process_of is not None:
+        # later_union[x][P]: union of key footprints of P's steps after
+        # position x — one backwards sweep, queried per (between, proc)
+        acc: Dict[str, FrozenSet[str]] = {}
+        rev: List[Dict[str, FrozenSet[str]]] = []
+        for label in reversed(sched):
+            rev.append(dict(acc))
+            if label != CONTINUE:
+                p = process_of(label)
+                acc[p] = acc.get(p, frozenset()) | \
+                    res.keys_of.get(label, frozenset({GLOBAL_KEY}))
+        later_union = list(reversed(rev))
     for i in range(start, limit):
         chosen = sched[i]
         for alt in res.options_at[i]:
@@ -1152,102 +1183,65 @@ def _backtrack_alternatives(
             if j is None:
                 out.append((i, alt))  # never ran (truncation): explore
                 continue
-            between = sched[i:j]
-            if any(
-                _conflicts(
-                    akeys,
-                    res.keys_of.get(x, frozenset({GLOBAL_KEY})),
-                )
-                for x in between
-                if x != CONTINUE
-            ):
+            aproc = process_of(alt) if process_of is not None else None
+            branch = False
+            for x_i in range(i, j):
+                x = sched[x_i]
+                if x == CONTINUE:
+                    continue
+                xkeys = res.keys_of.get(x, frozenset({GLOBAL_KEY}))
+                if conflicts(akeys, xkeys):
+                    branch = True
+                    break
+                if aproc is not None and \
+                        process_of(x) != aproc and conflicts(
+                            xkeys, later_union[x_i].get(aproc, frozenset())
+                        ):
+                    branch = True
+                    break
+            if branch:
                 out.append((i, alt))
     return out
 
 
-def shrink_schedule(
-    scenario: Scenario, schedule: List[str], target_kinds: Set[str],
-    seeded_bugs: Sequence[str], stop_after: bool,
-    max_attempts: int = 400,
-) -> Tuple[List[str], List[Violation]]:
-    """Minimize a violating schedule: greedy prefix truncation, then
-    single-step delta removal. Every candidate is re-executed from
-    scratch; a candidate survives only if it still produces a violation
-    of one of the original kinds."""
+@dataclasses.dataclass
+class EngineStats:
+    """What the generic DFS+sampling engine hands back to its caller."""
 
-    def still_bad(cand: List[str]) -> Optional[List[Violation]]:
-        try:
-            r = run_world(
-                scenario, Chooser(cand, stop_after=stop_after),
-                seeded_bugs=seeded_bugs,
-            )
-        except ScheduleDiverged:
-            return None
-        if r.violation_kinds & target_kinds:
-            return r.violations
-        return None
-
-    attempts = 0
-    current = list(schedule)
-    best_viol = still_bad(current)
-    if best_viol is None:  # pragma: no cover - caller passes a violator
-        return current, []
-    if stop_after:
-        # truncate: shortest prefix that still violates
-        lo, hi = 0, len(current)
-        while lo < hi and attempts < max_attempts:
-            mid = (lo + hi) // 2
-            attempts += 1
-            v = still_bad(current[:mid])
-            if v is not None:
-                hi = mid
-                best_viol = v
-            else:
-                lo = mid + 1
-        current = current[:hi]
-    changed = True
-    while changed and attempts < max_attempts:
-        changed = False
-        # downward single-step removals: dropping index i leaves the
-        # positions below it valid, so one pass is index-stable
-        i = len(current) - 1
-        while i >= 0 and attempts < max_attempts:
-            cand = current[:i] + current[i + 1:]
-            attempts += 1
-            v = still_bad(cand)
-            if v is not None:
-                current = cand
-                best_viol = v
-                changed = True
-            i -= 1
-    return current, best_viol
+    violating: Optional[Any]
+    dfs_runs: int
+    sampled_runs: int
+    pruned: int
+    queued: int
 
 
-def explore(
-    scenario: Scenario,
-    max_schedules: int = 500,
-    max_depth: Optional[int] = 30,
-    samples: int = 100,
-    seed: int = 0,
-    seeded_bugs: Sequence[str] = (),
+def dfs_explore(
+    run_fn: Callable[[Chooser], Any],
+    *,
+    max_schedules: int,
+    max_depth: Optional[int],
+    samples: int,
+    seed: int,
     wall_cap_s: Optional[float] = None,
-    shrink: bool = True,
-    step_limit: int = 600,
-) -> ExploreResult:
-    """DFS + random-sampling exploration of one scenario. Stops at the
-    first violating schedule (shrinking it), or when the schedule budget
-    / wall cap runs out."""
+    conflicts: Callable[[FrozenSet[str], FrozenSet[str]], bool] = _conflicts,
+    process_of: Optional[Callable[[str], str]] = None,
+    on_result: Optional[Callable[[Any], None]] = None,
+) -> EngineStats:
+    """Generic exploration loop: bounded-depth DFS with persistent-set
+    pruning over ``run_fn``'s schedules, then seeded-random sampling.
+    ``run_fn(chooser)`` executes ONE schedule from a fresh world and
+    returns the duck-typed run result; it may raise ScheduleDiverged.
+    Stops at the first violating result."""
     import random
 
     t0 = _time.monotonic()
     frontier: List[Tuple[str, ...]] = [()]
     seen: Set[Tuple[str, ...]] = {()}
-    coverage: Set[Tuple[str, str]] = set()
     dfs_runs = 0
     sampled_runs = 0
     pruned = 0
     queued = 0
-    violating: Optional[WorldResult] = None
+    violating = None
 
     def out_of_wall() -> bool:
         return (
@@ -1263,18 +1257,18 @@ def explore(
     while frontier and not out_of_budget() and violating is None:
         prefix = frontier.pop()
         try:
-            res = run_world(
-                scenario, Chooser(prefix), seeded_bugs=seeded_bugs,
-                step_limit=step_limit,
-            )
+            res = run_fn(Chooser(prefix))
         except ScheduleDiverged:  # pragma: no cover - determinism guard
             continue
         dfs_runs += 1
-        coverage |= interleaving_coverage(res.events)
+        if on_result is not None:
+            on_result(res)
         if res.violations:
             violating = res
             break
-        alts = _backtrack_alternatives(res, len(prefix), max_depth)
+        alts = _backtrack_alternatives(res, len(prefix), max_depth,
+                                       conflicts=conflicts,
+                                       process_of=process_of)
         total_alts = 0
         for i, alt in reversed(alts):
             total_alts += 1
@@ -1302,24 +1296,163 @@ def explore(
     ):
         rng = random.Random(rng_base.getrandbits(64))
         try:
-            res = run_world(
-                scenario, Chooser(rng=rng), seeded_bugs=seeded_bugs,
-                step_limit=step_limit,
-            )
+            res = run_fn(Chooser(rng=rng))
         except ScheduleDiverged:  # pragma: no cover
             continue
         sampled_runs += 1
-        coverage |= interleaving_coverage(res.events)
+        if on_result is not None:
+            on_result(res)
         if res.violations:
             violating = res
 
+    return EngineStats(
+        violating=violating,
+        dfs_runs=dfs_runs,
+        sampled_runs=sampled_runs,
+        pruned=pruned,
+        queued=queued,
+    )
+
+
+def shrink_generic(
+    run_fn: Callable[[Chooser], Any],
+    schedule: List[str],
+    target_kinds: Set[str],
+    stop_after: bool,
+    max_attempts: int = 400,
+    chooser_factory: Optional[
+        Callable[[Sequence[str], bool], Chooser]
+    ] = None,
+    blocks_of: Optional[Callable[[List[str]], List[Tuple[int, int]]]] = None,
+) -> Tuple[List[str], List[Violation]]:
+    """Minimize a violating schedule: greedy prefix truncation, then
+    single-step delta removal — plus, when ``blocks_of`` is given,
+    contiguous-block removal (a world whose step labels carry per-actor
+    op counters renumbers every later label when one op is dropped, so
+    only whole blocks — e.g. a spin-wait iteration — can go; pair with a
+    counter-insensitive ``chooser_factory``). Every candidate is
+    re-executed from scratch via ``run_fn``; a candidate survives only
+    if it still produces a violation of one of the original kinds."""
+    if chooser_factory is None:
+        chooser_factory = lambda prefix, stop: Chooser(  # noqa: E731
+            prefix, stop_after=stop
+        )
+
+    def still_bad(cand: List[str]) -> Optional[List[Violation]]:
+        try:
+            r = run_fn(chooser_factory(cand, stop_after))
+        except ScheduleDiverged:
+            return None
+        if r.violation_kinds & target_kinds:
+            return r.violations
+        return None
+
+    attempts = 0
+    current = list(schedule)
+    best_viol = still_bad(current)
+    if best_viol is None:  # pragma: no cover - caller passes a violator
+        return current, []
+    if stop_after:
+        # truncate: shortest prefix that still violates
+        lo, hi = 0, len(current)
+        while lo < hi and attempts < max_attempts:
+            mid = (lo + hi) // 2
+            attempts += 1
+            v = still_bad(current[:mid])
+            if v is not None:
+                hi = mid
+                best_viol = v
+            else:
+                lo = mid + 1
+        current = current[:hi]
+    changed = True
+    while changed and attempts < max_attempts:
+        changed = False
+        if blocks_of is not None:
+            # contiguous-block removals first (largest wins fastest)
+            for s, e in sorted(blocks_of(current),
+                               key=lambda b: b[0] - b[1]):
+                if attempts >= max_attempts:
+                    break
+                cand = current[:s] + current[e:]
+                attempts += 1
+                v = still_bad(cand)
+                if v is not None:
+                    current = cand
+                    best_viol = v
+                    changed = True
+                    break
+            if changed:
+                continue
+        # downward single-step removals: dropping index i leaves the
+        # positions below it valid, so one pass is index-stable
+        i = len(current) - 1
+        while i >= 0 and attempts < max_attempts:
+            cand = current[:i] + current[i + 1:]
+            attempts += 1
+            v = still_bad(cand)
+            if v is not None:
+                current = cand
+                best_viol = v
+                changed = True
+            i -= 1
+    return current, best_viol
+
+
+def shrink_schedule(
+    scenario: Scenario, schedule: List[str], target_kinds: Set[str],
+    seeded_bugs: Sequence[str], stop_after: bool,
+    max_attempts: int = 400,
+) -> Tuple[List[str], List[Violation]]:
+    """GCS-scenario instantiation of :func:`shrink_generic`."""
+    return shrink_generic(
+        lambda chooser: run_world(scenario, chooser,
+                                  seeded_bugs=seeded_bugs),
+        schedule, target_kinds, stop_after, max_attempts=max_attempts,
+    )
+
+
+def explore(
+    scenario: Scenario,
+    max_schedules: int = 500,
+    max_depth: Optional[int] = 30,
+    samples: int = 100,
+    seed: int = 0,
+    seeded_bugs: Sequence[str] = (),
+    wall_cap_s: Optional[float] = None,
+    shrink: bool = True,
+    step_limit: int = 600,
+) -> ExploreResult:
+    """DFS + random-sampling exploration of one scenario (via the
+    generic :func:`dfs_explore` engine). Stops at the first violating
+    schedule (shrinking it), or when the schedule budget / wall cap
+    runs out."""
+    t0 = _time.monotonic()
+    coverage: Set[Tuple[str, str]] = set()
+
+    stats = dfs_explore(
+        lambda chooser: run_world(
+            scenario, chooser, seeded_bugs=seeded_bugs,
+            step_limit=step_limit,
+        ),
+        max_schedules=max_schedules,
+        max_depth=max_depth,
+        samples=samples,
+        seed=seed,
+        wall_cap_s=wall_cap_s,
+        on_result=lambda res: coverage.update(
+            interleaving_coverage(res.events)
+        ),
+    )
+    violating = stats.violating
+
     result = ExploreResult(
         scenario=scenario.name,
-        schedules_run=dfs_runs + sampled_runs,
-        dfs_schedules=dfs_runs,
-        sampled_schedules=sampled_runs,
-        branches_pruned=pruned,
-        branches_queued=queued,
+        schedules_run=stats.dfs_runs + stats.sampled_runs,
+        dfs_schedules=stats.dfs_runs,
+        sampled_schedules=stats.sampled_runs,
+        branches_pruned=stats.pruned,
+        branches_queued=stats.queued,
         coverage=coverage,
         elapsed_s=_time.monotonic() - t0,
         violating=violating,

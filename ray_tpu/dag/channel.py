@@ -11,17 +11,20 @@ dir, tmpfs when session_dir_root points there), which every same-host
 process can map read-write. Cross-node edges fall back to a push over the
 daemon RPC transfer path (``rpc_dag_push`` / ``rpc_dag_pull``).
 
-Seqlock layout (128-byte header, little-endian u64 words, payload after):
+Seqlock layout (128-byte header, little-endian u64 words, payload after).
+:data:`HEADER_LAYOUT` below is the single source of truth — the runtime
+word offsets (``_W_*``), this table, and the ``analysis/memmodel.py``
+checker's virtual memory are all derived from it:
 
 ====  =========  ====================================================
 word  name       meaning
 ====  =========  ====================================================
 0     magic      0x52544348 ("RTCH"); readers poll for it (creation)
-1     flags      bit0 CLOSED (graceful), bit1 ERROR (peer died)
-2     version    seq of the last committed frame (0 = none yet)
-3     ack        seq of the last consumed frame
-4     len        payload byte length of the current frame
-5     reserved   (frame flags; unused — error-ness rides the payload)
+1     closed     1 = closed gracefully (peer drains, then raises)
+2     error      1 = peer died (pending frames are DROPPED, not drained)
+3     version    seq of the last committed frame (0 = none yet)
+4     ack        seq of the last consumed frame
+5     len        payload byte length of the current frame
 6     wclock     writer's Lamport clock at commit (trace merge)
 7     rclock     reader's Lamport clock at ack (trace merge)
 8     capacity   payload-area size; readers remap when len exceeds
@@ -34,6 +37,31 @@ frame: backpressure), writes payload then bumps ``version``; the reader
 blocks on a version bump, copies the payload, then advances ``ack``.
 Blocking is adaptive polling (spin, then sleep) — same-host latency is a
 few microseconds and no cross-process futex is portable from Python.
+
+``closed`` and ``error`` are SEPARATE words, each only ever blind-stored
+to 1, never read-modify-written: the memmodel checker proved the
+earlier single-``flags``-word design loses bits when a graceful
+teardown ``close()`` races the daemon death sweep's :func:`poke_error`
+(both did load-OR-store; the loser's store clears the winner's bit —
+e.g. ERROR dropped, turning "peer died" into a clean drain). Blind
+one-shot stores to distinct words cannot lose updates without needing a
+cross-process CAS Python does not have. The reader's wait loop also
+samples ``closed`` BEFORE ``version`` — in program order the writer
+publishes ``version`` before ``closed``, so a reader that saw
+``closed == 0`` re-polls, and a reader that sees ``closed == 1`` is
+guaranteed to also see every prior commit; the first memmodel run
+caught the reversed order dropping a committed final frame
+("closed AND drained" judged from a stale ``version`` snapshot).
+
+Every header-word load/store and payload copy goes through the
+:class:`ChannelMem` ops layer (:class:`MmapMem` in production; the
+memmodel checker substitutes a virtual memory with controlled
+scheduling). The ``chan-raw-header-access`` lint rejects any raw
+struct/mmap access outside a ``*Mem`` class, and the memmodel round-trip
+gate AST-extracts the op sequences of :meth:`Channel.write` /
+:meth:`Channel.read` / :meth:`Channel.close` / :func:`poke_error` and
+matches them against the checker's declared model — the code below IS
+the checked protocol.
 
 Happens-before: ``wclock``/``rclock`` carry each side's Lamport clock
 through the shared memory (frames here never cross the RPC layer, so the
@@ -100,13 +128,59 @@ _FLUSH_EVERY = 64
 
 MAGIC = 0x52544348  # "RTCH"
 HDR = 128
-FLAG_CLOSED = 1
-FLAG_ERROR = 2
 
-_W_MAGIC, _W_FLAGS, _W_VERSION, _W_ACK, _W_LEN, _W_FFLAGS, _W_WCLOCK, \
-    _W_RCLOCK, _W_CAP = range(9)
+#: Single source of truth for the seqlock header: ``(name, meaning)``
+#: per u64 word, in layout order. The ``_W_*`` struct offsets, the module
+#: docstring table, and ``analysis/memmodel.py``'s virtual memory are all
+#: derived from (or test-checked against) this table. The header reserves
+#: 128 bytes, so up to 16 words fit without a layout version bump.
+#: ``closed``/``error`` are write-once blind-store words — see the
+#: protocol notes in the module docstring.
+HEADER_LAYOUT: Tuple[Tuple[str, str], ...] = (
+    ("magic", 'creation sentinel 0x52544348 ("RTCH"); readers poll for it'),
+    ("closed", "1 = closed gracefully (peer drains, then raises)"),
+    ("error", "1 = peer died (pending frames dropped, not drained)"),
+    ("version", "seq of the last committed frame (0 = none yet)"),
+    ("ack", "seq of the last consumed frame"),
+    ("len", "payload byte length of the current frame"),
+    ("wclock", "writer's Lamport clock at commit (trace merge)"),
+    ("rclock", "reader's Lamport clock at ack (trace merge)"),
+    ("capacity", "payload-area size; readers remap when len exceeds it"),
+)
+
+WORDS = {name: i for i, (name, _) in enumerate(HEADER_LAYOUT)}
+
+_W_MAGIC = WORDS["magic"]
+_W_CLOSED = WORDS["closed"]
+_W_ERROR = WORDS["error"]
+_W_VERSION = WORDS["version"]
+_W_ACK = WORDS["ack"]
+_W_LEN = WORDS["len"]
+_W_WCLOCK = WORDS["wclock"]
+_W_RCLOCK = WORDS["rclock"]
+_W_CAP = WORDS["capacity"]
 
 _U64 = struct.Struct("<Q")
+
+#: Test-only regression switch (mirror of ``gcs.SEEDED_BUGS``): known,
+#: fixed-by-construction protocol bugs the memmodel checker must find and
+#: shrink to prove it earns its keep. Names:
+#:
+#: - ``version-before-payload``: publish the new seq BEFORE the payload
+#:   lands (the classic seqlock torn-read bug);
+#: - ``skip-remap-reread``: skip the reader's grow-in-place remap check,
+#:   so a frame larger than the reader's mapping reads stale bytes.
+SEEDED_BUGS: set = set()
+
+# Chaos hook for the worker-kill-at-mid-commit test: when set (env
+# RAY_TPU_CHAN_CRASH_AT, honored only in daemon-spawned worker processes
+# so a driver/test process never self-kills), write() hard-exits at the
+# named point. "pre-version" = after the payload+len stores, before the
+# version bump — the torn-commit window crash consistency must cover.
+_CRASH_AT = (
+    os.environ.get("RAY_TPU_CHAN_CRASH_AT")
+    if os.environ.get("RAY_TPU_WORKER_ID") else None
+)
 
 
 class ChannelClosedError(RayTpuError):
@@ -116,6 +190,118 @@ class ChannelClosedError(RayTpuError):
 
 class ChannelTimeoutError(GetTimeoutError):
     """A channel read/write exceeded its deadline."""
+
+
+class ChannelMem:
+    """The channel's word-operation seam: every header-word load/store,
+    payload copy, and grow/remap goes through one of these. Production is
+    :class:`MmapMem` (raw mmap over the channel file); the memmodel
+    checker substitutes a virtual memory whose every op is a scheduling
+    point, and tests can wrap any impl in a recording shim. The analog of
+    ``cluster/runtime.py``'s runtime seam, one layer down."""
+
+    def load(self, word: int) -> int:
+        raise NotImplementedError
+
+    def store(self, word: int, value: int) -> None:
+        raise NotImplementedError
+
+    def read_payload(self, length: int) -> bytes:
+        raise NotImplementedError
+
+    def write_payload(self, payload: bytes) -> None:
+        raise NotImplementedError
+
+    def grow(self, new_capacity: int) -> None:
+        """Grow the backing file to ``HDR + new_capacity`` and extend
+        this end's mapping over it."""
+        raise NotImplementedError
+
+    def remap(self) -> None:
+        """Re-check the backing file size and extend this end's mapping
+        (the reader's half of grow-in-place)."""
+        raise NotImplementedError
+
+    def size(self) -> int:
+        """Bytes this end currently has mapped (header included)."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        raise NotImplementedError
+
+
+class MmapMem(ChannelMem):
+    """Production ops layer: a raw ``mmap`` over the channel file. The
+    ONLY code in ``dag/``/``object_store/`` allowed to touch header words
+    or payload bytes directly — ``chan-raw-header-access`` enforces it."""
+
+    def __init__(self, path: str, mm: mmap.mmap, fd: int):
+        self.path = path
+        self._mm = mm
+        self._fd = fd
+
+    # ------------------------------------------------------------ lifecycle
+
+    @classmethod
+    def create(cls, path: str, capacity: int) -> "MmapMem":
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o600)
+        os.ftruncate(fd, HDR + capacity)
+        mm = mmap.mmap(fd, HDR + capacity)
+        return cls(path, mm, fd)
+
+    @classmethod
+    def open(cls, path: str, length: int = 0) -> Optional["MmapMem"]:
+        """Map an existing channel file (``length`` 0 = whole file);
+        returns None when the file is still smaller than the header."""
+        fd = os.open(path, os.O_RDWR)
+        size = os.fstat(fd).st_size
+        if size < HDR:
+            os.close(fd)
+            return None
+        mm = mmap.mmap(fd, length or size)
+        return cls(path, mm, fd)
+
+    def close(self) -> None:
+        mm, self._mm = self._mm, None
+        if mm is not None:
+            try:
+                mm.close()
+            except BufferError:
+                pass  # an exported view is still alive; leak the map
+        if self._fd >= 0:
+            os.close(self._fd)
+            self._fd = -1
+
+    # ------------------------------------------------------------ word ops
+
+    def load(self, word: int) -> int:
+        return _U64.unpack_from(self._mm, word * 8)[0]
+
+    def store(self, word: int, value: int) -> None:
+        _U64.pack_into(self._mm, word * 8, value)
+
+    def read_payload(self, length: int) -> bytes:
+        return bytes(self._mm[HDR:HDR + length])
+
+    def write_payload(self, payload: bytes) -> None:
+        self._mm[HDR:HDR + len(payload)] = payload
+
+    def grow(self, new_capacity: int) -> None:
+        os.ftruncate(self._fd, HDR + new_capacity)
+        self.remap()
+
+    def remap(self) -> None:
+        size = os.fstat(self._fd).st_size
+        if size > len(self._mm):
+            old, self._mm = self._mm, mmap.mmap(self._fd, size)
+            try:
+                old.close()
+            except BufferError:
+                pass
+
+    def size(self) -> int:
+        return len(self._mm)
 
 
 def _tracer():
@@ -142,11 +328,15 @@ class Channel:
     attach with :meth:`open_wait`, polling for the magic word.
     """
 
-    def __init__(self, path: str, mm: mmap.mmap, fd: int, key: str):
+    def __init__(self, path: str, mem: ChannelMem, key: str):
         self.path = path
         self.key = key
-        self._mm = mm
-        self._fd = fd
+        self._mem = mem
+        # hot-path binding: one call frame per word op instead of two —
+        # the ops seam costs ~2us per frame pair through an unbound
+        # double dispatch (bench.py obs_overhead micro), ~1us bound
+        self._get = mem.load
+        self._put = mem.store
         self._closed_local = False
         # per-end metric accumulators (SPSC: each end is single-threaded,
         # so plain attributes race-free); flushed every _FLUSH_EVERY
@@ -176,12 +366,9 @@ class Channel:
 
     @classmethod
     def create(cls, path: str, capacity: int, key: str) -> "Channel":
-        os.makedirs(os.path.dirname(path), exist_ok=True)
-        fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o600)
-        os.ftruncate(fd, HDR + capacity)
-        mm = mmap.mmap(fd, HDR + capacity)
-        ch = cls(path, mm, fd, key)
-        for w in (_W_FLAGS, _W_VERSION, _W_ACK, _W_LEN, _W_FFLAGS,
+        mem = MmapMem.create(path, capacity)
+        ch = cls(path, mem, key)
+        for w in (_W_CLOSED, _W_ERROR, _W_VERSION, _W_ACK, _W_LEN,
                   _W_WCLOCK, _W_RCLOCK):
             ch._put(w, 0)
         ch._put(_W_CAP, capacity)
@@ -196,19 +383,13 @@ class Channel:
         deadline = time.monotonic() + timeout
         while True:
             try:
-                fd = os.open(path, os.O_RDWR)
+                mem = MmapMem.open(path)
             except FileNotFoundError:
-                fd = -1
-            if fd >= 0:
-                size = os.fstat(fd).st_size
-                if size >= HDR:
-                    mm = mmap.mmap(fd, size)
-                    ch = cls(path, mm, fd, key)
-                    if ch._get(_W_MAGIC) == MAGIC:
-                        return ch
-                    ch._mm = None
-                    mm.close()
-                os.close(fd)
+                mem = None  # not created yet: poll; real I/O errors raise
+            if mem is not None:
+                if mem.load(_W_MAGIC) == MAGIC:
+                    return cls(path, mem, key)
+                mem.close()
             if should_stop is not None and should_stop():
                 raise ChannelClosedError(f"channel {key} never appeared "
                                          "(stage stopping)")
@@ -220,25 +401,25 @@ class Channel:
             time.sleep(0.002)
 
     def close(self, error: bool = False) -> None:
-        """Set the CLOSED (and optionally ERROR) flag, waking both ends.
-        Idempotent; the mapping stays valid for a draining peer."""
-        if self._mm is None:
+        """Set the closed (and optionally error) word, waking both ends.
+        Idempotent; the mapping stays valid for a draining peer. BLIND
+        one-shot stores — a load-OR-store here would race poke_error and
+        lose the peer-died bit (memmodel's close-vs-poke scenario)."""
+        if self._mem is None:
             return
-        flags = self._get(_W_FLAGS) | FLAG_CLOSED | (FLAG_ERROR if error else 0)
-        self._put(_W_FLAGS, flags)
+        # error FIRST: a peer waking between the two stores must already
+        # see the fatal bit — the reverse order opens a window where a
+        # death-close drains like a graceful one
+        if error:
+            self._put(_W_ERROR, 1)
+        self._put(_W_CLOSED, 1)
 
     def detach(self) -> None:
         """Drop this end's mapping (does NOT unlink the file)."""
         self._closed_local = True
-        mm, self._mm = self._mm, None
-        if mm is not None:
-            try:
-                mm.close()
-            except BufferError:
-                pass  # an exported view is still alive; leak the map
-        if self._fd >= 0:
-            os.close(self._fd)
-            self._fd = -1
+        mem, self._mem = self._mem, None
+        if mem is not None:
+            mem.close()
 
     @staticmethod
     def unlink(path: str) -> None:
@@ -248,20 +429,17 @@ class Channel:
             pass
 
     # ------------------------------------------------------------ low-level
-
-    def _get(self, word: int) -> int:
-        return _U64.unpack_from(self._mm, word * 8)[0]
-
-    def _put(self, word: int, value: int) -> None:
-        _U64.pack_into(self._mm, word * 8, value)
+    # (_get/_put are the per-channel bindings of mem.load/mem.store made
+    # in __init__ — the spelling the publication-order checker and the
+    # memmodel op extraction recognize)
 
     @property
     def closed(self) -> bool:
-        return bool(self._get(_W_FLAGS) & FLAG_CLOSED)
+        return bool(self._get(_W_CLOSED))
 
     @property
     def errored(self) -> bool:
-        return bool(self._get(_W_FLAGS) & FLAG_ERROR)
+        return bool(self._get(_W_ERROR))
 
     def _raise_closed(self) -> None:
         if self.errored:
@@ -269,15 +447,6 @@ class Channel:
                 f"channel {self.key}: peer died (stage worker or node lost)"
             )
         raise ChannelClosedError(f"channel {self.key} is closed")
-
-    def _remap(self) -> None:
-        size = os.fstat(self._fd).st_size
-        if size > len(self._mm):
-            old, self._mm = self._mm, mmap.mmap(self._fd, size)
-            try:
-                old.close()
-            except BufferError:
-                pass
 
     def _park(self, spins: int) -> None:
         # adaptive wait: stay hot for the first ~1k polls (same-host
@@ -297,7 +466,7 @@ class Channel:
         deadline = None if timeout is None else time.monotonic() + timeout
         spins = 0
         while True:
-            if self._get(_W_FLAGS) & (FLAG_CLOSED | FLAG_ERROR):
+            if self._get(_W_ERROR) or self._get(_W_CLOSED):
                 self._raise_closed()
             version = self._get(_W_VERSION)
             if self._get(_W_ACK) == version:
@@ -311,15 +480,22 @@ class Channel:
                 )
             self._park(spins)
             spins += 1
-        need = len(payload)
-        if need > self._get(_W_CAP):
-            new_cap = max(need, 2 * self._get(_W_CAP))
-            os.ftruncate(self._fd, HDR + new_cap)
-            self._remap()
-            self._put(_W_CAP, new_cap)
-        self._mm[HDR:HDR + need] = payload
-        self._put(_W_LEN, need)
         seq = version + 1
+        need = len(payload)
+        cap = self._get(_W_CAP)
+        if need > cap:
+            new_cap = max(need, 2 * cap)
+            self._mem.grow(new_cap)
+            self._put(_W_CAP, new_cap)
+        if "version-before-payload" in SEEDED_BUGS:
+            # SEEDED BUG (test-only; see SEEDED_BUGS above): publish the
+            # new seq before the payload lands — a reader that wakes here
+            # copies the previous frame's bytes under the new seq
+            self._put(_W_VERSION, seq)  # ray-lint: disable=chan-publication-order
+        self._mem.write_payload(payload)
+        self._put(_W_LEN, need)
+        if _CRASH_AT == "pre-version":
+            os._exit(3)  # chaos hook: die inside the torn-commit window
         t = _tracer()
         if t is not None:
             t.merge_clock(self._get(_W_RCLOCK))
@@ -347,13 +523,19 @@ class Channel:
         deadline = None if timeout is None else time.monotonic() + timeout
         spins = 0
         while True:
-            if self._get(_W_FLAGS) & FLAG_ERROR:
+            if self._get(_W_ERROR):
                 self._raise_closed()
+            # closed is sampled BEFORE version: the writer publishes its
+            # last commit before closing, so closed==1 here implies the
+            # version load below already sees every committed frame —
+            # the reversed order let a racing graceful close drop a
+            # committed final frame (caught by memmodel's first run)
+            closed = self._get(_W_CLOSED)
             ack = self._get(_W_ACK)
             version = self._get(_W_VERSION)
             if version > ack:
                 break
-            if self._get(_W_FLAGS) & FLAG_CLOSED:
+            if closed:
                 self._raise_closed()  # closed AND drained
             if should_stop is not None and should_stop():
                 raise ChannelClosedError(f"channel {self.key}: stage stopping")
@@ -364,9 +546,13 @@ class Channel:
             self._park(spins)
             spins += 1
         need = self._get(_W_LEN)
-        if HDR + need > len(self._mm):
-            self._remap()  # writer grew the file under us
-        payload = bytes(self._mm[HDR:HDR + need])
+        if "skip-remap-reread" not in SEEDED_BUGS:
+            # grow-in-place: the writer may have grown the file under us;
+            # SEEDED BUG skip-remap-reread drops this re-check, so a big
+            # frame reads a short (stale) mapping
+            if HDR + need > self._mem.size():
+                self._mem.remap()
+        payload = self._mem.read_payload(need)
         seq = version
         t = _tracer()
         if t is not None:
@@ -387,21 +573,21 @@ class Channel:
 
 
 def poke_error(path: str) -> bool:
-    """Flag an existing channel file CLOSED|ERROR without attaching a full
-    end — used by the daemon to wake every parked reader/writer of a DAG
-    whose pinned worker just died. Returns False when the file is absent
-    (channel never created — nothing parked on it)."""
+    """Flag an existing channel file closed+errored without attaching a
+    full end — used by the daemon to wake every parked reader/writer of a
+    DAG whose pinned worker just died. Returns False when the file is
+    absent (channel never created — nothing parked on it). Blind stores:
+    racing a graceful close() must not lose either side's bit."""
     try:
-        fd = os.open(path, os.O_RDWR)
+        mem = MmapMem.open(path, length=HDR)
     except OSError:
         return False
+    if mem is None:
+        return False
     try:
-        if os.fstat(fd).st_size < HDR:
-            return False
-        mm = mmap.mmap(fd, HDR)
-        flags = _U64.unpack_from(mm, _W_FLAGS * 8)[0]
-        _U64.pack_into(mm, _W_FLAGS * 8, flags | FLAG_CLOSED | FLAG_ERROR)
-        mm.close()
+        # error first — see Channel.close
+        mem.store(_W_ERROR, 1)
+        mem.store(_W_CLOSED, 1)
         return True
     finally:
-        os.close(fd)
+        mem.close()

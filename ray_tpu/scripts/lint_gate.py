@@ -19,13 +19,21 @@ Runs, in order, failing fast with a distinct exit code per contract:
    any invariant violation on any explored interleaving fails the gate
    (artifact: ``explore.json`` with per-scenario schedule counts and
    handler-pair coverage);
+4b. optionally (``--memmodel``) the word-level seqlock-channel model
+   checker (analysis/memmodel.py): the op-sequence round-trip gate
+   against ``dag/channel.py``, a wall-capped exploration of the channel
+   scenario library (kill-at-any-op included), and the seeded-bug
+   regression — both ``channel.SEEDED_BUGS`` must be found and shrink
+   to <= 12-op replays (artifact: ``memmodel.json``; counterexamples
+   land as ``memmodel_replay.json``);
 5. optionally (``--tier1``) the tier-1 pytest run with ``--durations=25``,
    teeing output to an artifact file so CI keeps a per-test timing
    budget trail (see BENCH_NOTES.md "Tier-1 wall-cap hygiene").
 
 Artifacts land in ``--artifact-dir`` (default ``artifacts/``):
 ``lint.json`` (machine-readable findings), ``protocol.json`` (the dumped
-model), ``tier1_durations.txt`` (when --tier1 ran).
+model), ``memmodel.json`` (when --memmodel ran), ``tier1_durations.txt``
+(when --tier1 ran).
 """
 
 from __future__ import annotations
@@ -61,6 +69,20 @@ def main(argv=None) -> int:
     ap.add_argument("--explore-wall-cap", type=float, default=60.0,
                     help="seconds per scenario (default 60, sized for "
                          "the 2-CPU box)")
+    ap.add_argument("--memmodel", action="store_true",
+                    help="also run the word-level channel model checker "
+                         "(analysis/memmodel.py): op-sequence round-trip "
+                         "gate, full scenario library (kill-at-any-op), "
+                         "and the seeded-bug regression (both bugs must "
+                         "be found and shrink to <= 12 ops)")
+    ap.add_argument("--memmodel-budget", type=int, default=1000,
+                    help="DFS schedules per channel scenario "
+                         "(default 1000)")
+    ap.add_argument("--memmodel-samples", type=int, default=300,
+                    help="random schedules per channel scenario "
+                         "(default 300)")
+    ap.add_argument("--memmodel-wall-cap", type=float, default=30.0,
+                    help="seconds per channel scenario (default 30)")
     ap.add_argument("--tier1", action="store_true",
                     help="also run the tier-1 suite with --durations=25 "
                          "and save the output as an artifact")
@@ -176,6 +198,85 @@ def main(argv=None) -> int:
             return 1
         print(f"explore: {total} schedules across "
               f"{len(report)} scenarios, 0 violations")
+
+    # (4b) word-level channel model checker: static round-trip gate +
+    # exhaustive-ish interleaving run + seeded-bug regression teeth
+    if args.memmodel:
+        from ray_tpu.analysis import memmodel as _memmodel
+
+        failed = False
+        report = {"round_trip": [], "scenarios": {}, "seeded": {}}
+        report["round_trip"] = _memmodel.verify_op_sequences()
+        for msg in report["round_trip"]:
+            print(f"lint_gate: memmodel round-trip: {msg}",
+                  file=sys.stderr)
+            failed = True
+        if not report["round_trip"]:
+            print("memmodel: op-sequence round-trip holds "
+                  "(write/read/close/poke_error vs DECLARED_SEQUENCES)")
+        total = 0
+        for name in sorted(_memmodel.CHANNEL_SCENARIOS):
+            res = _memmodel.explore_channel(
+                _memmodel.CHANNEL_SCENARIOS[name],
+                max_schedules=args.memmodel_budget,
+                samples=args.memmodel_samples,
+                wall_cap_s=args.memmodel_wall_cap,
+            )
+            print("memmodel: " + res.summary())
+            total += res.schedules_run
+            report["scenarios"][name] = {
+                "schedules": res.schedules_run,
+                "pruned": res.branches_pruned,
+                "ops": res.ops_covered,
+                "crash_points": len(res.crash_points),
+                "violations": [
+                    v.format()
+                    for v in (res.violating.violations if res.found else [])
+                ],
+                "shrunk": res.shrunk,
+            }
+            if res.found:
+                failed = True
+                cex = os.path.join(args.artifact_dir,
+                                   "memmodel_replay.json")
+                _memmodel.write_channel_replay(cex, res)
+                print(f"lint_gate: channel counterexample replay: {cex} "
+                      "(python -m ray_tpu.analysis --replay)",
+                      file=sys.stderr)
+        # regression teeth: each seeded bug must be FOUND and shrink small
+        for bug, scen in _memmodel.SEEDED_BUG_SCENARIOS:
+            res = _memmodel.explore_channel(
+                _memmodel.CHANNEL_SCENARIOS[scen],
+                max_schedules=args.memmodel_budget,
+                samples=args.memmodel_samples,
+                seeded_bugs=[bug],
+                wall_cap_s=args.memmodel_wall_cap,
+            )
+            found = res.found and len(res.shrunk or ()) <= 12
+            report["seeded"][bug] = {
+                "scenario": scen,
+                "found": res.found,
+                "shrunk_ops": len(res.shrunk or ()) if res.found else None,
+            }
+            if not found:
+                failed = True
+                print(f"lint_gate: seeded channel bug {bug!r} "
+                      + ("shrank to "
+                         f"{len(res.shrunk or res.violating.schedule)} "
+                         "ops (> 12)" if res.found else "NOT FOUND")
+                      + " — the checker lost its teeth", file=sys.stderr)
+            else:
+                print(f"memmodel: seeded bug {bug} found in {scen}, "
+                      f"shrunk to {len(res.shrunk)} ops")
+        with open(os.path.join(args.artifact_dir, "memmodel.json"),
+                  "w") as f:
+            json.dump(report, f, indent=2)
+        if failed:
+            print("lint_gate: channel memory model gate failed",
+                  file=sys.stderr)
+            return 1
+        print(f"memmodel: {total} schedules across "
+              f"{len(report['scenarios'])} scenarios, 0 violations")
 
     # (5) tier-1 with per-test durations as a CI artifact. The pytest
     # process writes a final metrics snapshot at exit (util/metrics.py

@@ -417,6 +417,52 @@ def test_dag_worker_kill_raises_channel_closed(invariant_sanitizer,
         cluster.shutdown()
 
 
+def test_dag_worker_crash_inside_commit_window_never_torn(monkeypatch):
+    """Kill a pinned worker at a SEEDED mid-commit op — inside the torn
+    window the memmodel checker verifies: payload + len stored, version
+    not yet bumped (channel.py's RAY_TPU_CHAN_CRASH_AT hook, honored
+    only in daemon-spawned workers). The driver must see
+    ChannelClosedError; a returned value would be a torn or stale-seq
+    frame leaking through, a hang a lost wakeup."""
+    ray_tpu.shutdown()  # drop the module fixture's shared runtime, if any
+    # worker processes inherit the env at spawn; the driver (this
+    # process) has no RAY_TPU_WORKER_ID, so only pinned workers die
+    monkeypatch.setenv("RAY_TPU_CHAN_CRASH_AT", "pre-version")
+    cluster = Cluster()
+    cluster.add_node(num_cpus=2)
+    cluster.wait_for_nodes(1)
+    ray_tpu.init(address=cluster.address)
+    try:
+        @ray_tpu.remote
+        def f(x):
+            return x + 1
+
+        @ray_tpu.remote
+        def g(x):
+            return x * 2
+
+        with InputNode() as inp:
+            dag = g.bind(f.bind(inp))
+        compiled = dag.compile()
+        with pytest.raises(ChannelClosedError):
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                try:
+                    out = compiled.execute(1, timeout=5.0)
+                except ChannelTimeoutError:
+                    continue  # death sweep not landed yet: keep waiting
+                pytest.fail(
+                    f"execute returned {out!r} though every stage "
+                    "writer dies inside the commit window — a torn or "
+                    "stale frame leaked through"
+                )
+            pytest.fail("execute never raised after mid-commit crash")
+        compiled.teardown()
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
 def test_dag_node_kill_raises_channel_closed():
     """Kill a whole node hosting a pinned stage: the GCS's death sweep
     marks the DAG broken and the driver raises instead of hanging."""
