@@ -969,11 +969,34 @@ def obs_frame_overhead():
             best = min(best, (time.perf_counter() - t0) / reps)
         return best * 1e6
 
+    # serve fast-path per-request observability cost: the client router
+    # accumulates one latency float per response and flushes blocks of 64
+    # through the precomputed-key histogram path (serve/fastpath.py); the
+    # replica side adds one batch-size observation per dispatch GROUP, so
+    # the per-request bound is accum + flush-amortized observe
+    sh = _m.Histogram("ray_tpu_bench_serve_req_s", "bench-only")  # ray-lint: disable=metric-name-invalid
+    skey = sh.series_key()
+
+    def serve_accum_cost(reps=200_000, tries=5):
+        best = float("inf")
+        for _ in range(tries):
+            acc = []
+            t0 = time.perf_counter()
+            for i in range(reps):
+                acc.append(0.001)
+                if len(acc) >= 64:
+                    block, acc = acc, []
+                    for v in block:
+                        sh.observe_k(skey, v)
+            best = min(best, (time.perf_counter() - t0) / reps)
+        return best * 1e6
+
     return {
         "chan_pair_on_us": round(pair_on, 3),
         "chan_pair_off_us": round(pair_off, 3),
         "chan_pair_delta_us": round(pair_on - pair_off, 3),
         "rpc_handler_wrapper_us": round(wrapper_cost(), 3),
+        "serve_accum_us": round(serve_accum_cost(), 3),
     }
 
 
@@ -1020,12 +1043,20 @@ def obs_overhead_bench():
     gate_pct = edges * max(micro["chan_pair_delta_us"], 0.0) \
         / base_iter_us * 100.0
     e2e_pct = (dag_on_us / dag_off_us - 1.0) * 100.0
+    # serve fast-path gate: per request = 2 channel edges (req+resp) of
+    # metrics delta + the router's latency accumulator, against the
+    # measured ~1.2ms serial fast-path round trip (BENCH_serve_r01)
+    serve_req_us = 1200.0
+    serve_pct = (2 * max(micro["chan_pair_delta_us"], 0.0)
+                 + micro["serve_accum_us"]) / serve_req_us * 100.0
     return {
         **micro,
         "dag_edges_per_iter": edges,
         "dag_baseline_iter_us": base_iter_us,
         "dag_dispatch_overhead_pct": round(gate_pct, 3),
         "meets_3pct_bar": gate_pct < 3.0,
+        "serve_request_overhead_pct": round(serve_pct, 4),
+        "serve_meets_3pct_bar": serve_pct < 3.0,
         "e2e_dag_on_iter_us": dag_on_us,
         "e2e_dag_off_iter_us": dag_off_us,
         "e2e_dag_overhead_pct_noisy": round(e2e_pct, 2),
@@ -1034,6 +1065,46 @@ def obs_overhead_bench():
         "storm_cpu_ms_per_task_on": storm_on["cpu_ms_per_task"],
         "storm_cpu_ms_per_task_off": storm_off["cpu_ms_per_task"],
         "dag_on": dag_on, "dag_off": dag_off,
+    }
+
+
+def serve_storm_bench(duration_s=20.0, clients=48, replicas=3, seed=7):
+    """ISSUE-12 acceptance bench (recorded as BENCH_serve_rNN.json):
+
+    1. task-layer serve throughput (fast_path=False, no chaos);
+    2. fast-path serve throughput (no chaos) — bar: >= 5x over (1);
+    3. the chaos storm (replica kills + node kills) with the SLO gate —
+       bar: zero lost / zero duplicate / zero wrong responses, error rate
+       within budget, p99 under the chaos bound.
+
+    All three phases run on identical topologies (STABLE controller node
+    + churn nodes) via scripts/serve_storm.py's harness. 48 closed-loop
+    clients: the fast path keeps scaling with offered concurrency while
+    the task layer is control-plane bound, so the ratio is measured where
+    the serving plane actually operates (heavy traffic), not at the
+    comparator's sweet spot."""
+    from ray_tpu.scripts.serve_storm import run_storm
+
+    base = run_storm(duration_s=duration_s, clients=clients,
+                     replicas=replicas, chaos=False, seed=seed,
+                     fast_path=False)
+    log(f"serve_storm task-layer: {base}")
+    fast = run_storm(duration_s=duration_s, clients=clients,
+                     replicas=replicas, chaos=False, seed=seed,
+                     fast_path=True)
+    log(f"serve_storm fastpath: {fast}")
+    storm = run_storm(duration_s=duration_s, clients=clients,
+                      replicas=replicas, chaos=True, seed=seed,
+                      kill_period_s=4.0, fast_path=True)
+    log(f"serve_storm chaos: {storm}")
+    ratio = fast["goodput_rps"] / max(base["goodput_rps"], 1e-9)
+    return {
+        "task_layer": base,
+        "fastpath": fast,
+        "storm": storm,
+        "speedup": round(ratio, 2),
+        "meets_5x_bar": ratio >= 5.0,
+        "slo_pass": bool(storm["slo_pass"]),
     }
 
 
@@ -1110,6 +1181,21 @@ def main():
             "value": r["dag_dispatch_overhead_pct"],
             "unit": "% (compiled dag iter, metrics+recorder on vs off)",
             "configs": {"obs_overhead": r},
+        }))
+        return
+
+    if sys.argv[1:] == ["serve_storm"]:
+        # serve fast-path acceptance bench: task-layer vs fastpath rps +
+        # the chaos storm SLO gate — prints one JSON line (recorded as
+        # BENCH_serve_rNN.json); pure host python, no TPU probe
+        r = serve_storm_bench()
+        log(f"serve_storm speedup {r['speedup']}x, storm goodput "
+            f"{r['storm']['goodput_rps']} rps, slo_pass {r['slo_pass']}")
+        print(json.dumps({
+            "metric": "serve_fastpath_speedup_over_task_layer",
+            "value": r["speedup"],
+            "unit": "x (closed-loop goodput rps, same topology/workload)",
+            "configs": {"serve_storm": r},
         }))
         return
 

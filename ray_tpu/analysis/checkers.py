@@ -1063,8 +1063,10 @@ class ResourceSpecChecker(Checker):
 # --------------------------------------------------------- unbounded-rpc-call
 
 # Directory segments that count as control plane: a blocked thread there
-# wedges a daemon loop, the GCS, or a driver's submission path.
-_CONTROL_PLANE_SEGMENTS = {"cluster", "dag"}
+# wedges a daemon loop, the GCS, or a driver's submission path. serve/ is
+# included since its fast path (serve/fastpath.py) talks to daemons
+# directly for pair registration.
+_CONTROL_PLANE_SEGMENTS = {"cluster", "dag", "serve"}
 
 
 @register

@@ -83,6 +83,13 @@ METHOD_TABLE: Dict[str, str] = {
     "dag_start_stage": "stage worker pinning",
     "dag_push": "channel frame deposit (chan seq alternation)",
     "dag_pull": "channel frame consume (chan seq alternation)",
+    # serve fast path (ray_tpu/serve/fastpath.py): pair registration is
+    # the plane's only control traffic; request/response frames ride the
+    # same per-channel seq-alternation invariant as dag edges
+    "serve_register": "fast-path pair registration (placement + sweep)",
+    "serve_teardown": "fast-path pair release + channel teardown",
+    "serve_attach": "pair channel creation + replica worker attach",
+    "serve_replica_ready": "replica loop attach acknowledgement",
 }
 
 _EPS = 1e-4

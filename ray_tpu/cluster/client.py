@@ -1415,6 +1415,26 @@ class ClusterClient:
             ent["state"] = p.get("state")
             ent["error"] = p.get("error")
 
+    # --- serve fast-path pair control plane (ray_tpu/serve/fastpath.py):
+    # registration-time only; steady-state requests ride the channels ---
+
+    def serve_register(self, payload: dict) -> dict:
+        return self.gcs.call("serve_register", payload,
+                             timeout=self._rpc_timeout)
+
+    def serve_teardown(self, pair_id: str) -> dict:
+        return self.gcs.call("serve_teardown", {"pair_id": pair_id},
+                             timeout=self._rpc_timeout)
+
+    def node_alive(self, node_id: str) -> Optional[bool]:
+        """Liveness of a node per this client's pushed snapshot (no RPC);
+        None when the node is unknown. The fast-path router's parked
+        reads probe this so a killed NODE (whose daemon can no longer
+        poke its channels) still wakes the client."""
+        with self._lock:
+            n = self._nodes.get(node_id)
+        return None if n is None else bool(n.get("alive", True))
+
     def dag_register(self, payload: dict) -> dict:
         return self.gcs.call("dag_register", payload, timeout=self._rpc_timeout)
 

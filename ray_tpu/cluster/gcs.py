@@ -97,6 +97,13 @@ class GcsServer:
         # holds, state}; stage capacity holds live in self.running under
         # "dag-hold-<dag>-<stage>" keys (like actor lifetime holds)
         self.dags: Dict[str, dict] = {}
+        # serve fast-path pair registry (ray_tpu/serve/fastpath.py):
+        # pair_id -> {owner, owner_conn, actor_id, node_id}. Registration
+        # is the pair's ONE control-plane round trip; the registry exists
+        # so a vanished owner's pairs are swept on driver disconnect and a
+        # dead node's entries are dropped with it. No state field: a pair
+        # either exists or was torn down.
+        self.serve_pairs: Dict[str, dict] = {}
         self.directory: Dict[str, set] = defaultdict(set)  # object_id -> {node_id}
         self.drivers: Dict[int, dict] = {}  # conn_id -> {driver_id}
         # GCS-initiated request/response clients to node daemons (the push
@@ -1497,6 +1504,61 @@ class GcsServer:
         self._kick()
         return {"ok": True}
 
+    # --- serve fast-path pair registry (ray_tpu/serve/fastpath.py; the
+    # GCS's role is registration-time only: resolve the replica actor to
+    # its node, record the pair for disconnect/node-death sweeps, and
+    # propagate teardown. Steady-state requests never come back here.) ---
+
+    def rpc_serve_register(self, p, conn):
+        """Client (handle/proxy) -> GCS: register one fast-path pair
+        against a replica actor. Returns the replica node's placement info
+        (addr/port/chan_dir) so the client can attach channels via that
+        node's daemon — the pair's single control-plane round trip."""
+        with self._lock:
+            if conn.conn_id not in self.drivers:
+                # owner's disconnect sweep already ran (same guard as
+                # rpc_dag_register): accepting would record a pair no
+                # sweep will ever clean up
+                return {"ok": False, "error": "owner driver is not connected"}
+            a = self.actors.get(p["actor_id"])
+            if a is None or a.get("state") == "DEAD":
+                return {"ok": False,
+                        "error": f"replica actor {p['actor_id']} is "
+                                 "dead/unknown"}
+            if a.get("state") != "ALIVE" or not a.get("node_id"):
+                return {"ok": False, "retry": True,
+                        "error": f"replica actor {p['actor_id']} not "
+                                 "ALIVE yet"}
+            n = self.nodes.get(a["node_id"])
+            if not n or not n.get("alive"):
+                return {"ok": False, "retry": True,
+                        "error": "replica node not alive"}
+            self.serve_pairs[p["pair_id"]] = {
+                "pair_id": p["pair_id"],
+                "owner": p.get("owner"),
+                "owner_conn": conn.conn_id,
+                "actor_id": p["actor_id"],
+                "node_id": a["node_id"],
+            }
+            return {
+                "ok": True,
+                "node_id": a["node_id"],
+                "addr": n["addr"],
+                "port": n["port"],
+                "chan_dir": n.get("chan_dir"),
+            }
+
+    def rpc_serve_teardown(self, p, conn):
+        """Client -> GCS: drop a pair's registration and tell its node's
+        daemon to close + unlink the channels. Idempotent — a second
+        teardown (or one racing the disconnect sweep) finds nothing."""
+        with self._lock:
+            pair = self.serve_pairs.pop(p["pair_id"], None)
+        if pair is not None:
+            self._push_to_node(pair["node_id"], "serve_teardown",
+                               {"pair_id": p["pair_id"]})
+        return {"ok": True}
+
     def rpc_dag_spans(self, p, conn):
         """Per-iteration stage spans from the exec loops, merged into the
         task-event log so the timeline shows hot-loop occupancy."""
@@ -2143,6 +2205,7 @@ class GcsServer:
                 self._mark_node_dead(node_id, "daemon connection lost")
         if driver_id:
             dag_sweep = []  # (dag_id, nodes) torn down with their driver
+            pair_sweep = []  # (pair_id, node_id) swept with their owner
             with self._lock:
                 self.drivers.pop(conn.conn_id, None)
                 # a RetryingRpcClient reconnect re-registers on a NEW conn
@@ -2166,11 +2229,21 @@ class GcsServer:
                         dag_sweep.append(
                             (dag_id, set(dag["stages"].values()))
                         )
+                    # a dead owner's serve fast-path pairs would leave
+                    # their replica loops parked on half-open channels:
+                    # tear them down on its behalf (same contract as dags)
+                    for pid, pair in list(self.serve_pairs.items()):
+                        if pair.get("owner") != driver_id:
+                            continue
+                        del self.serve_pairs[pid]
+                        pair_sweep.append((pid, pair["node_id"]))
             for dag_id, nodes in dag_sweep:
                 for nid in nodes:
                     self._push_to_node(
                         nid, "dag_teardown", {"dag_id": dag_id}
                     )
+            for pid, nid in pair_sweep:
+                self._push_to_node(nid, "serve_teardown", {"pair_id": pid})
 
     def _health_loop(self):
         period = self.config.health_check_period_ms / 1000.0
@@ -2205,6 +2278,12 @@ class GcsServer:
                          node_id=node_id, cause=cause)
             n["alive"] = False
             self.state.remove_node(node_id)
+            # the node's serve fast-path pairs died with it: drop the
+            # registrations (clients detect the death through their node
+            # snapshot probe / relay errors and reroute)
+            for pid in [pid for pid, pair in self.serve_pairs.items()
+                        if pair.get("node_id") == node_id]:
+                del self.serve_pairs[pid]
             # retire the dead node's gauge series; its counters stay in
             # the cumulative aggregate (delta-merge is restart-safe)
             self.metrics_agg.drop_source(node_id)

@@ -215,6 +215,8 @@ class _Worker:
         self.current_task: Optional[dict] = None
         # compiled-DAG stages pinned to this worker: {(dag_id, stage)}
         self.dag_stages: set = set()
+        # serve fast-path pairs attached to this worker's replica: {pair_id}
+        self.serve_pairs: set = set()
 
 
 class NodeDaemon:
@@ -273,6 +275,11 @@ class NodeDaemon:
         )
         os.makedirs(self.chan_dir, exist_ok=True)
         self._dags: Dict[str, dict] = {}  # dag_id -> {stages, keys}
+        # serve fast-path pairs homed on this node (ray_tpu/serve/fastpath):
+        # pair_id -> {worker_id, actor_id, keys, paths}; channels live in
+        # _chan_index/_chan_paths like dag edges, so the relay fallback
+        # (rpc_dag_push/rpc_dag_pull) and the death sweep cover them too
+        self._serve_pairs: Dict[str, dict] = {}
         self._chan_paths: Dict[str, str] = {}  # channel key -> local path
         self._chan_index: Dict[str, Any] = {}  # key -> Channel this daemon holds
         self._dag_pending: deque = deque()  # stage specs awaiting a worker
@@ -344,6 +351,7 @@ class NodeDaemon:
         )
         self.gcs.subscribe("return_bundle", self._on_return_bundle)
         self.gcs.subscribe("dag_teardown", self._on_dag_teardown)
+        self.gcs.subscribe("serve_teardown", self._on_serve_teardown)
         self.gcs.subscribe("nodes", self._on_nodes_update)
         self.gcs.connect()
         self._beat_thread = threading.Thread(
@@ -511,6 +519,11 @@ class NodeDaemon:
             # readers/writers wake with ChannelClosedError, never hang)
             # and report up — the GCS pushes dag_update to the owner
             self._on_dag_worker_died(w)
+        if w and w.serve_pairs:
+            # same sweep for serve fast-path pairs: clients' parked reads
+            # raise ChannelClosedError and the router reroutes in-flight
+            # requests to surviving replicas
+            self._on_serve_worker_died(w)
         if w and w.current_task:
             # worker crashed mid-task -> report failure (reference:
             # NodeManager worker death handling -> task failure)
@@ -1500,6 +1513,152 @@ class NodeDaemon:
                     pass
             if path:
                 Channel.unlink(path)
+
+    # --- serve fast-path pairs (ray_tpu/serve/fastpath.py): the daemon
+    # creates each pair's request/response channel files under its
+    # chan_dir, registers them for the relay fallback AND its worker-death
+    # sweep, and hands the pair to the worker hosting the replica actor ---
+
+    def rpc_serve_attach(self, p, conn):
+        """Client -> daemon: build one fast-path pair against the replica
+        actor hosted here. Creates both channels, registers the death
+        poke, pushes the attach spec to the replica's worker, and defers
+        the reply until the worker reports serve_replica_ready — so a
+        successful return means the request plane is LIVE."""
+        from ray_tpu.dag.channel import Channel
+
+        if self._stopped:
+            return {"ok": False, "error": "daemon stopping"}
+        pair_id, aid = p["pair_id"], p["actor_id"]
+        cap = int(p.get("capacity") or 65536)
+        with self._lock:
+            existing = self._serve_pairs.get(pair_id)
+        if existing is not None:
+            # idempotent re-attach (retry-plane resend of the same call)
+            req_path, resp_path = existing["paths"]
+            return {"ok": True, "req_path": req_path,
+                    "resp_path": resp_path}
+        with self._lock:
+            w = next(
+                (w for w in self.workers.values() if w.actor_id == aid),
+                None,
+            )
+        if w is None or w.conn is None:
+            # the actor moved/died between the GCS resolve and this call:
+            # the client refreshes membership and re-routes
+            return {"ok": False, "retry": True,
+                    "error": f"actor {aid} not hosted on {self.node_id}"}
+        keys = (f"{pair_id}-rq", f"{pair_id}-rs")
+        paths = tuple(f"{self.chan_dir}/{k}.chan" for k in keys)
+        for key, path in zip(keys, paths):
+            made = None
+            if key not in self._chan_index:
+                made = Channel.create(path, cap, key)
+            with self._lock:
+                cur = (self._chan_index.setdefault(key, made)
+                       if made is not None else None)
+                self._chan_paths[key] = path
+            if made is not None and cur is not made:
+                made.detach()  # racer won: drop OUR mapping only
+        fut = self.server.loop.create_future()
+        with self._lock:
+            self._pending_rpc[f"servepair-{pair_id}"] = fut
+            self._serve_pairs[pair_id] = {
+                "pair_id": pair_id,
+                "worker_id": w.worker_id,
+                "actor_id": aid,
+                "keys": keys,
+                "paths": paths,
+            }
+            w.serve_pairs.add(pair_id)
+        spec = {
+            "pair_id": pair_id,
+            "actor_id": aid,
+            "req_path": paths[0],
+            "resp_path": paths[1],
+            "batch_max": self.config.serve_fastpath_batch_max,
+            "target_latency_s": self.config.serve_fastpath_target_latency_s,
+        }
+        self.server.call_soon(
+            lambda c=w.conn, s=spec: asyncio.ensure_future(
+                c.push("serve_attach", s)
+            )
+        )
+        return fut
+
+    def rpc_serve_replica_ready(self, p, conn):
+        """Worker notify: the replica loop attached the pair's channels
+        (or failed to) — resolves the client's pending serve_attach."""
+        pair_id = p["pair_id"]
+        with self._lock:
+            fut = self._pending_rpc.pop(f"servepair-{pair_id}", None)
+            sp = self._serve_pairs.get(pair_id)
+        if fut is None:
+            return {"ok": True}
+        if p.get("ok", True) and sp is not None:
+            reply = {"ok": True, "req_path": sp["paths"][0],
+                     "resp_path": sp["paths"][1]}
+        else:
+            reply = {"ok": False, "retry": True,
+                     "error": p.get("error") or "replica attach failed"}
+        self.server.call_soon(
+            lambda: fut.set_result(reply) if not fut.done() else None
+        )
+        return {"ok": True}
+
+    def _close_serve_pair(self, sp: dict) -> None:
+        """Close + unlink one pair's channels (wakes both ends)."""
+        from ray_tpu.dag.channel import Channel
+
+        for key, path in zip(sp["keys"], sp["paths"]):
+            with self._lock:
+                ch = self._chan_index.pop(key, None)
+                self._chan_paths.pop(key, None)
+            if ch is not None:
+                try:
+                    ch.close()
+                    ch.detach()
+                except Exception:  # noqa: BLE001
+                    pass
+            Channel.unlink(path)
+
+    def _on_serve_teardown(self, p):
+        """GCS push (client teardown or owner-disconnect sweep): release
+        the pair's channels on this node. Idempotent."""
+        with self._lock:
+            sp = self._serve_pairs.pop(p["pair_id"], None)
+            if sp is not None:
+                w = self.workers.get(sp["worker_id"])
+                if w is not None:
+                    w.serve_pairs.discard(p["pair_id"])
+        if sp is not None:
+            self._close_serve_pair(sp)
+
+    def _on_serve_worker_died(self, w: "_Worker"):
+        """A worker hosting fast-path replicas died: flag every pair
+        channel CLOSED|ERROR so parked clients wake with
+        ChannelClosedError and reroute — the serve half of the dag death
+        sweep. Entries stay until teardown so the files still unlink."""
+        from ray_tpu.dag import channel as _chan
+
+        with self._lock:
+            pairs = [self._serve_pairs.get(pid)
+                     for pid in list(w.serve_pairs)]
+            futs = [self._pending_rpc.pop(f"servepair-{pid}", None)
+                    for pid in list(w.serve_pairs)]
+        for sp in pairs:
+            if sp is None:
+                continue
+            for path in sp["paths"]:
+                _chan.poke_error(path)
+        for fut in futs:
+            if fut is not None:
+                self.server.call_soon(
+                    lambda f=fut: f.set_result({
+                        "ok": False, "retry": True,
+                        "error": "replica worker died before ready",
+                    }) if not f.done() else None
+                )
 
     # --- 2PC bundle protocol, GCS-initiated (reference:
     # placement_group_resource_manager.cc Prepare/Commit/ReturnBundle;

@@ -698,6 +698,9 @@ class RetryingRpcClient:
         # PG ops are dedupe-guarded server-side (duplicate create returns
         # the current state; remove/kill are idempotent pops)
         "create_placement_group", "remove_placement_group", "kill_actor",
+        # serve fast-path pair plane: register overwrites the same pair_id
+        # idempotently, teardown is an idempotent pop
+        "serve_register", "serve_teardown",
     })
 
     def __init__(self, host: str, port: int, timeout: Optional[float] = None,

@@ -631,6 +631,63 @@ def _on_dag_loop(client: RpcClient):
     return handler
 
 
+# ---- serve fast-path replica loops (ray_tpu/serve/fastpath.py): one
+# ReplicaFastPath per hosted replica actor drains the request channels the
+# daemon attaches via `serve_attach` pushes — no control plane per request.
+_serve_fp: dict = {}  # actor_id -> ReplicaFastPath
+_serve_fp_lock = threading.Lock()
+
+
+def _serve_attach(client: RpcClient, p: dict):
+    aid = p["actor_id"]
+    # the attach may race the actor's creation task: wait for the instance
+    deadline = time.time() + 30.0
+    inst = _actor_instances.get(aid)
+    while inst is None and time.time() < deadline:
+        time.sleep(0.01)
+        inst = _actor_instances.get(aid)
+    try:
+        if inst is None:
+            raise RuntimeError(f"actor {aid} never materialized here")
+        from ray_tpu.serve.fastpath import ReplicaFastPath
+
+        with _serve_fp_lock:
+            fp = _serve_fp.get(aid)
+            if fp is None:
+                fp = _serve_fp[aid] = ReplicaFastPath(
+                    inst, aio=_actor_aio.get(aid),
+                    batch_max=int(p.get("batch_max") or 64),
+                    target_latency_s=float(
+                        p.get("target_latency_s") or 0.02
+                    ),
+                )
+        fp.attach(p["pair_id"], p["req_path"], p["resp_path"])
+    except BaseException as e:  # noqa: BLE001 - reported to the daemon
+        try:
+            client.notify("serve_replica_ready", {
+                "pair_id": p["pair_id"], "ok": False, "error": repr(e),
+            })
+        except Exception:  # noqa: BLE001 - daemon already gone
+            pass
+        return
+    try:
+        client.notify("serve_replica_ready", {
+            "pair_id": p["pair_id"], "ok": True,
+        })
+    except Exception:  # noqa: BLE001 - daemon already gone
+        pass
+
+
+def _on_serve_attach(client: RpcClient):
+    def handler(p: dict):
+        threading.Thread(
+            target=_serve_attach, args=(client, p), daemon=True,
+            name=f"serve-fp-attach-{p['pair_id'][-8:]}",
+        ).start()
+
+    return handler
+
+
 def main():  # pragma: no cover - runs as a subprocess
     global _daemon_client
     host = os.environ["RAY_TPU_DAEMON_HOST"]
@@ -652,6 +709,7 @@ def main():  # pragma: no cover - runs as a subprocess
     client.subscribe("stream_ack", _on_stream_ack)
     client.subscribe("dag_loop", _on_dag_loop(client))
     client.subscribe("dag_stop", _on_dag_stop)
+    client.subscribe("serve_attach", _on_serve_attach(client))
     client.on_close = lambda: os._exit(0)  # daemon gone -> exit
     # Install the cluster runtime NOW (env RAY_TPU_GCS_ADDR -> ClusterClient)
     # rather than relying on lazy auto-init: threaded-actor methods run on

@@ -81,6 +81,18 @@ _DEFS: Dict[str, tuple] = {
     # default per-iteration deadline for CompiledDAG.execute — bounds every
     # channel wait so a dead pipeline raises instead of parking forever
     "dag_execute_timeout_s": (float, 60.0),
+    # --- serve fast path (ray_tpu/serve/fastpath.py): the zero-RPC request
+    # plane over dag-style shm channel pairs ---
+    # initial payload area per request/response channel (grow-in-place)
+    "serve_fastpath_channel_bytes": (int, 65536),
+    # continuous batcher: hard cap on one dispatch group
+    "serve_fastpath_batch_max": (int, 64),
+    # target end-to-end latency the adaptive batch sizer aims at: batch
+    # size ~= target / EMA(per-item service time), clamped to batch_max
+    "serve_fastpath_target_latency_s": (float, 0.02),
+    # router membership refresh cadence (a BACKGROUND thread, so the
+    # steady-state request path stays RPC-free; failures force a refresh)
+    "serve_fastpath_refresh_s": (float, 1.0),
     "num_workers_soft_limit": (int, 0),  # 0 -> num_cpus
     "worker_start_timeout_s": (float, 30.0),
     "metrics_report_interval_ms": (float, 2000.0),

@@ -338,6 +338,12 @@ class Channel:
         self._get = mem.load
         self._put = mem.store
         self._closed_local = False
+        # polls before a waiting end yields the core (see _park). The dag
+        # driver loop keeps the hot default (its peer answers in
+        # microseconds and owns a core); the serve fast path turns this
+        # DOWN on its ends — many parked ends sharing a loaded host would
+        # burn the GIL spinning while the peer computes
+        self.spin_hot = 1000
         # per-end metric accumulators (SPSC: each end is single-threaded,
         # so plain attributes race-free); flushed every _FLUSH_EVERY
         # frames — see the module-level observability comment
@@ -449,12 +455,12 @@ class Channel:
         raise ChannelClosedError(f"channel {self.key} is closed")
 
     def _park(self, spins: int) -> None:
-        # adaptive wait: stay hot for the first ~1k polls (same-host
+        # adaptive wait: stay hot for the first spin_hot polls (same-host
         # hand-off is microseconds), then yield the core
-        if spins < 1000:
+        if spins < self.spin_hot:
             time.sleep(0)
         else:
-            time.sleep(0.0002 if spins < 5000 else 0.002)
+            time.sleep(0.0002 if spins < self.spin_hot + 4000 else 0.002)
 
     # ------------------------------------------------------------ data path
 
@@ -570,6 +576,25 @@ class Channel:
                     _M_READ_STALL.observe_k(_NOTAG, time.monotonic() - t0)
                 self._flush_metrics(need)
         return seq, payload
+
+    def try_read(self) -> Optional[Tuple[int, bytes]]:
+        """Non-blocking poll: consume the next frame if one is committed,
+        else return ``None``. Raises :class:`ChannelClosedError` exactly
+        like :meth:`read` once the channel is closed/errored AND drained.
+
+        This is the primitive the serve fast path's replica loop drains
+        MANY request channels with (``ray_tpu/serve/fastpath.py``): each
+        (client, replica) pair is one strictly-SPSC channel — the MPSC
+        request plane is the *set* of pairs, polled round-robin, so no
+        channel ever has two writers or two readers and the seqlock
+        alternation invariant holds per pair. Implemented as a
+        zero-deadline :meth:`read` so the checked protocol (publication
+        order, closed-before-version sampling) is reused verbatim rather
+        than duplicated."""
+        try:
+            return self.read(timeout=0)
+        except ChannelTimeoutError:
+            return None
 
 
 def poke_error(path: str) -> bool:

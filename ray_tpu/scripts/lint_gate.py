@@ -26,6 +26,10 @@ Runs, in order, failing fast with a distinct exit code per contract:
    regression — both ``channel.SEEDED_BUGS`` must be found and shrink
    to <= 12-op replays (artifact: ``memmodel.json``; counterexamples
    land as ``memmodel_replay.json``);
+4c. optionally (``--serve-storm``) the serve fast-path chaos storm in
+   smoke mode (scripts/serve_storm.py): closed-loop traffic under seeded
+   replica/node kills, gated on zero lost / duplicate / wrong responses
+   (artifact: ``serve_storm.json``);
 5. optionally (``--tier1``) the tier-1 pytest run with ``--durations=25``,
    teeing output to an artifact file so CI keeps a per-test timing
    budget trail (see BENCH_NOTES.md "Tier-1 wall-cap hygiene").
@@ -83,6 +87,13 @@ def main(argv=None) -> int:
                          "(default 300)")
     ap.add_argument("--memmodel-wall-cap", type=float, default=30.0,
                     help="seconds per channel scenario (default 30)")
+    ap.add_argument("--serve-storm", action="store_true",
+                    help="also run the serve fast-path chaos storm in "
+                         "SMOKE mode (scripts/serve_storm.py --smoke): "
+                         "short closed-loop phases under seeded replica/"
+                         "node kills with the SLO gate (zero lost / "
+                         "duplicate / wrong responses) wired into the "
+                         "exit code; artifact: serve_storm.json")
     ap.add_argument("--tier1", action="store_true",
                     help="also run the tier-1 suite with --durations=25 "
                          "and save the output as an artifact")
@@ -277,6 +288,23 @@ def main(argv=None) -> int:
             return 1
         print(f"memmodel: {total} schedules across "
               f"{len(report['scenarios'])} scenarios, 0 violations")
+
+    # (4c) serve fast-path chaos-storm smoke: the SLO gate (zero lost /
+    # duplicate / wrong responses under seeded kills) as a CI check
+    if args.serve_storm:
+        art = os.path.join(args.artifact_dir, "serve_storm.json")
+        proc = subprocess.run(
+            [sys.executable, "-m", "ray_tpu.scripts.serve_storm",
+             "--smoke", "--json", art],
+            cwd=REPO, capture_output=True, text=True,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        )
+        sys.stdout.write(proc.stdout)
+        if proc.returncode != 0:
+            print("lint_gate: serve storm SLO gate RED", file=sys.stderr)
+            sys.stderr.write(proc.stderr[-2000:])
+            return 1
+        print(f"serve_storm: SLO green (artifact: {art})")
 
     # (5) tier-1 with per-test durations as a CI artifact. The pytest
     # process writes a final metrics snapshot at exit (util/metrics.py

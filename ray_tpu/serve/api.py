@@ -19,6 +19,12 @@ from ray_tpu.serve.proxy import HTTPProxy
 _CONTROLLER_NAME = "serve:controller"
 _PROXY_NAME = "serve:http_proxy"
 
+# Extra actor options merged into the controller's placement (e.g.
+# ``{"resources": {"STABLE": 0.01}}`` to pin it to a survivor node in
+# chaos runs — scripts/serve_storm.py and chaos_soak --serve use this;
+# replica placement stays per-deployment via ray_actor_options).
+CONTROLLER_OPTIONS: Dict[str, Any] = {}
+
 
 def _get_controller(create: bool = False):
     try:
@@ -27,7 +33,8 @@ def _get_controller(create: bool = False):
         if not create:
             raise RuntimeError("serve is not running (call serve.run first)")
         return ServeController.options(
-            name=_CONTROLLER_NAME, num_cpus=0, max_concurrency=16
+            name=_CONTROLLER_NAME, num_cpus=0, max_concurrency=16,
+            **CONTROLLER_OPTIONS
         ).remote()
 
 
@@ -73,6 +80,7 @@ def run(app: Application, *, name: str = "default",
             "autoscaling_config": d.autoscaling_config,
             "user_config": d.user_config,
             "version": d.version,
+            "fast_path": d.fast_path,
         })
     ray_tpu.get(ctrl.deploy_application.remote(
         name, specs, app.deployment.name))
@@ -113,6 +121,11 @@ def http_port() -> int:
 
 
 def shutdown():
+    # retire fast-path routers FIRST: their channel pairs + GCS pair
+    # registrations must not outlive the replicas they point at
+    from ray_tpu.serve import fastpath as _fastpath
+
+    _fastpath.shutdown_all()
     try:
         ctrl = ray_tpu.get_actor(_CONTROLLER_NAME)
         ray_tpu.get(ctrl.shutdown.remote(), timeout=10.0)

@@ -37,6 +37,13 @@ class _Item:
 
 
 class _Batcher:
+    def __reduce__(self):
+        # Queue state and threads are process-local; a batcher landing in
+        # another process (a @serve.batch-decorated class pickled into a
+        # cluster replica) starts fresh with the same configuration —
+        # by-value pickling is impossible anyway (locks/condvars inside).
+        return (_Batcher, (self.fn, self.max_batch_size, self.timeout_s))
+
     def __init__(self, fn: Callable[..., List[Any]], max_batch_size: int,
                  batch_wait_timeout_s: float):
         self.fn = fn
@@ -123,6 +130,55 @@ class _Batcher:
                 it.event.set()
 
 
+class AdaptiveBatchSizer:
+    """Target-latency-driven batch sizing for the serve fast path's
+    continuous batcher (reference points: Gavel sizes allocations to
+    measured throughput; continuous batching in LLM serving sizes the
+    running batch from the live request stream).
+
+    The replica loop asks :meth:`target` how many queued requests to
+    dispatch as one group and :meth:`wait_budget` how long a partial
+    group may coalesce; it feeds measured service times back through
+    :meth:`record`. The model: one item costs ``ema`` seconds, so a batch
+    of ``target_latency / ema`` items keeps the *oldest* item's
+    end-to-end latency near the target — more load -> bigger batches
+    (throughput), light load -> batch of 1 (latency). EMA over service
+    time, not throughput, so a reconfigured/slow model adapts within a
+    few batches."""
+
+    def __init__(self, target_latency_s: float = 0.02, max_batch: int = 64,
+                 alpha: float = 0.2):
+        self.target_latency_s = float(target_latency_s)
+        self.max_batch = max(int(max_batch), 1)
+        self._alpha = alpha
+        self._ema_item_s: Optional[float] = None
+
+    def record(self, batch_size: int, elapsed_s: float) -> None:
+        if batch_size <= 0:
+            return
+        per_item = max(elapsed_s / batch_size, 1e-7)
+        if self._ema_item_s is None:
+            self._ema_item_s = per_item
+        else:
+            self._ema_item_s += self._alpha * (per_item - self._ema_item_s)
+
+    def target(self) -> int:
+        if self._ema_item_s is None:
+            # no signal yet: take whatever is queued (the continuous-
+            # batching default) — the first measurement clamps from there.
+            # A target of 1 here would let a cold replica burn a whole
+            # burst through as singles before any feedback lands.
+            return self.max_batch
+        return max(1, min(self.max_batch,
+                          int(self.target_latency_s / self._ema_item_s)))
+
+    def wait_budget(self) -> float:
+        """How long a partial batch may wait for more arrivals before it
+        dispatches anyway: a quarter of the latency target, floored so an
+        idle replica still dispatches promptly."""
+        return max(self.target_latency_s * 0.25, 0.0005)
+
+
 def batch(_func: Optional[Callable] = None, *, max_batch_size: int = 10,
           batch_wait_timeout_s: float = 0.01):
     """Decorate a deployment method (or function) taking a LIST of inputs
@@ -134,7 +190,6 @@ def batch(_func: Optional[Callable] = None, *, max_batch_size: int = 10,
     def wrap(fn):
         # one batcher per (instance, method): replicas must not share state
         attr = f"__rt_batcher_{fn.__name__}"
-        attach_lock = threading.Lock()
         module_level = _Batcher(fn, max_batch_size, batch_wait_timeout_s)
 
         @functools.wraps(fn)
@@ -145,13 +200,15 @@ def batch(_func: Optional[Callable] = None, *, max_batch_size: int = 10,
                 inst, value = args
                 b = getattr(inst, attr, None)
                 if b is None:
-                    with attach_lock:  # two threads racing first use
-                        b = getattr(inst, attr, None)
-                        if b is None:
-                            b = _Batcher(
-                                fn, max_batch_size, batch_wait_timeout_s
-                            )
-                            setattr(inst, attr, b)
+                    # GIL-atomic attach (no lock in this closure: the
+                    # wrapper is pickled into cluster replicas with the
+                    # decorated class, and a captured Lock cell would make
+                    # the whole class unpicklable); racing first uses both
+                    # build a batcher, dict.setdefault keeps exactly one
+                    b = inst.__dict__.setdefault(
+                        attr, _Batcher(fn, max_batch_size,
+                                       batch_wait_timeout_s)
+                    )
                 return b.submit(inst, value)
             if len(args) == 1:  # plain function: (value,)
                 return module_level.submit(None, args[0])

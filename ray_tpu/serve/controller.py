@@ -28,6 +28,9 @@ class _DeploymentState:
         self.replica_ids: List[int] = []  # parallel to self.replicas
         # replica_id -> (ongoing, timestamp), pushed by replicas
         self.stats: Dict[int, tuple] = {}
+        # replica_id -> spawn time: a replica gets a startup grace window
+        # before the liveness sweep may declare it dead on silence
+        self.spawned_at: Dict[int, float] = {}
 
 
 @ray_tpu.remote(num_cpus=0)
@@ -95,7 +98,11 @@ class ServeController:
     def get_replicas(self, app_name: str, deployment_name: str):
         with self._lock:
             st = self._state(app_name, deployment_name)
-            return {"replicas": list(st.replicas), "version": st.version}
+            return {
+                "replicas": list(st.replicas),
+                "version": st.version,
+                "fast_path": bool(st.spec.get("fast_path")),
+            }
 
     def get_ingress(self, app_name: str) -> Optional[str]:
         with self._lock:
@@ -144,6 +151,7 @@ class ServeController:
         if idx >= 0 and idx < len(st.replica_ids):
             rid = st.replica_ids[idx]
             st.stats.pop(rid, None)
+            st.spawned_at.pop(rid, None)
         try:
             # best-effort, fire-and-forget thread stop on a replica that is
             # about to be killed — there is no result worth fetching
@@ -179,6 +187,7 @@ class ServeController:
                             )
                         )
                         st.replica_ids.append(rid)
+                        st.spawned_at[rid] = time.time()
                     st.version += 1
                 elif delta < 0:
                     for r in list(st.replicas[st.target:]):
@@ -188,6 +197,12 @@ class ServeController:
                     st.version += 1
 
     # --------------------------------------------------------- autoscaling
+    # a replica whose stats push has been silent this long (and that is
+    # past its startup grace) gets a health probe; probe failure = dead.
+    # Generous on purpose: GIL contention on a loaded 2-CPU host delays
+    # pushes, and a false kill churns the very replicas serving traffic.
+    REPLICA_SILENT_S = 5.0
+
     def _control_loop(self):
         while not self._stop:
             time.sleep(0.25)
@@ -195,6 +210,60 @@ class ServeController:
                 self._autoscale_tick()
             except Exception:
                 pass
+            try:
+                self._liveness_tick()
+            except Exception:
+                pass
+
+    def _liveness_tick(self):
+        """Detect crashed replicas and respawn them (reference:
+        deployment_state's replica health reconciliation). A replica
+        killed by a node/worker death stops pushing stats; after the
+        silence window it gets one direct health probe, and a failed
+        probe retires it so _reconcile_locked brings the deployment back
+        to target — the reconciliation the serve_storm chaos runs lean on
+        (the task-layer handle AND the fast-path router both just need
+        fresh membership; re-routing is theirs)."""
+        now = time.time()
+        suspects = []  # (st, replica, rid)
+        with self._lock:
+            for deps in self._apps.values():
+                for st in deps.values():
+                    for idx, rid in enumerate(st.replica_ids):
+                        if now - st.spawned_at.get(rid, now) < \
+                                self.REPLICA_SILENT_S:
+                            continue
+                        rec = st.stats.get(rid)
+                        if rec is not None and \
+                                now - rec[1] < self.REPLICA_SILENT_S:
+                            continue
+                        suspects.append((st, st.replicas[idx], rid))
+        dead = []
+        for st, replica, rid in suspects[:4]:  # bound probe work per tick
+            try:
+                ray_tpu.get(replica.health_check.remote(), timeout=2.0)
+                with self._lock:
+                    # answered: treat the probe as a fresh stats sample so
+                    # a quiet-but-alive replica isn't re-probed every tick
+                    st.stats.setdefault(rid, (0, time.time()))
+                    st.stats[rid] = (st.stats[rid][0], time.time())
+            except Exception:  # noqa: BLE001 - dead/unreachable
+                dead.append((st, replica, rid))
+        if not dead:
+            return
+        with self._lock:
+            for st, replica, rid in dead:
+                try:
+                    idx = st.replicas.index(replica)
+                except ValueError:
+                    continue  # already retired by a racing path
+                st.replicas.pop(idx)
+                rid = st.replica_ids.pop(idx)
+                st.stats.pop(rid, None)
+                st.spawned_at.pop(rid, None)
+                self._kill_replica(st, replica)
+                st.version += 1
+            self._reconcile_locked()
 
     def record_stats(self, identity, ongoing: int):
         app_name, dep_name, rid = identity

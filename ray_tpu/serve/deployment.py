@@ -36,6 +36,7 @@ class Deployment:
         autoscaling_config: Optional[AutoscalingConfig] = None,
         user_config: Optional[Dict[str, Any]] = None,
         version: str = "1",
+        fast_path: bool = False,
     ):
         self.func_or_class = func_or_class
         self.name = name
@@ -47,6 +48,11 @@ class Deployment:
         self.autoscaling_config = autoscaling_config
         self.user_config = user_config
         self.version = version
+        # fast_path=True: handles/proxies route requests over dag-style
+        # shm channel pairs (ray_tpu/serve/fastpath.py) — zero GCS RPCs
+        # per request in cluster mode; local mode falls back to the task
+        # layer (there is no daemon to pin channels on)
+        self.fast_path = bool(fast_path)
 
     def options(self, **kwargs) -> "Deployment":
         merged = dict(
@@ -56,6 +62,7 @@ class Deployment:
             autoscaling_config=self.autoscaling_config,
             user_config=self.user_config,
             version=self.version,
+            fast_path=self.fast_path,
         )
         name = kwargs.pop("name", self.name)
         merged.update(kwargs)
@@ -97,6 +104,7 @@ def deployment(
     autoscaling_config: Optional[AutoscalingConfig] = None,
     user_config: Optional[Dict[str, Any]] = None,
     version: str = "1",
+    fast_path: bool = False,
 ):
     """@serve.deployment / @serve.deployment(...) (reference: serve/api.py)."""
 
@@ -110,6 +118,7 @@ def deployment(
             autoscaling_config=autoscaling_config,
             user_config=user_config,
             version=version,
+            fast_path=fast_path,
         )
 
     if _func_or_class is not None:

@@ -75,6 +75,13 @@ class DeploymentHandle:
         self._inflight: Dict[str, List] = {}  # replica actor_id -> pending refs
         self._method_handles: Dict[str, "DeploymentHandle"] = {}
         self._rng = random.Random()
+        # serve fast path (ray_tpu/serve/fastpath.py): engaged when the
+        # deployment is fast_path=True AND the runtime is a cluster client
+        # (local mode has no daemon to pin channels on). The router holder
+        # is a one-slot list SHARED across method handles, so
+        # handle.method.remote() reuses the parent's channel pairs.
+        self._fast_path = False
+        self._fp_router: List = [None]
 
     # picklable: handles travel into other replicas for composition
     def __reduce__(self):
@@ -83,6 +90,8 @@ class DeploymentHandle:
 
     def options(self, method_name: Optional[str] = None) -> "DeploymentHandle":
         h = DeploymentHandle(self.deployment_name, self.app_name, method_name)
+        h._fast_path = self._fast_path
+        h._fp_router = self._fp_router  # share the channel pairs
         return h
 
     # --------------------------------------------------------------- routing
@@ -96,6 +105,7 @@ class DeploymentHandle:
         with self._lock:
             self._replicas = info["replicas"]
             self._replica_version = info["version"]
+            self._fast_path = bool(info.get("fast_path"))
             live = {r._actor_id for r in self._replicas}
             self._inflight = {
                 aid: refs for aid, refs in self._inflight.items() if aid in live
@@ -168,7 +178,63 @@ class DeploymentHandle:
             self._inflight.setdefault(replica._actor_id, []).append(ref)
         return ref, replica._actor_id
 
+    # ------------------------------------------------------------ fast path
+
+    def _fetch_membership(self):
+        """Router callback: replica actor ids + version, via the
+        controller. Called from the router's BACKGROUND refresher and its
+        failure paths — never the steady-state request path."""
+        from ray_tpu.serve.api import _get_controller
+
+        ctrl = _get_controller()
+        info = ray_tpu.get(
+            ctrl.get_replicas.remote(self.app_name, self.deployment_name)
+        )
+        return [r._actor_id for r in info["replicas"]], info["version"]
+
+    def _router(self):
+        """The shared FastPathRouter, built on first use (after a refresh
+        discovered fast_path=True on a cluster runtime)."""
+        r = self._fp_router[0]
+        if r is not None:
+            return r
+        with self._lock:
+            if self._fp_router[0] is None:
+                from ray_tpu.serve.fastpath import FastPathRouter
+
+                self._fp_router[0] = FastPathRouter(
+                    self.deployment_name, self.app_name,
+                    self._fetch_membership,
+                )
+            r = self._fp_router[0]
+        r.refresh_now()
+        return r
+
+    def _use_fastpath(self) -> bool:
+        if not self._fast_path:
+            return False
+        from ray_tpu.core import api as _api
+
+        rt = _api._runtime
+        # cluster clients expose the serve pair control plane; local mode
+        # (and torn-down runtimes) fall back to the task layer
+        return rt is not None and hasattr(rt, "serve_register")
+
+    def fastpath_stats(self) -> Optional[Dict[str, int]]:
+        """Router counters (submitted/completed/rerouted/duplicates/
+        failed) — what the chaos gates assert on; None before first use."""
+        r = self._fp_router[0]
+        return dict(r.stats) if r is not None else None
+
     def remote(self, *args, **kwargs) -> DeploymentResponse:
+        # engaged fast path first: no version-check RPC on the hot path —
+        # membership upkeep lives on the router's refresher thread
+        r = self._fp_router[0]
+        if r is not None and self._use_fastpath():
+            return r.submit(self._method_name, args, kwargs)
+        self._maybe_refresh()
+        if self._use_fastpath():
+            return self._router().submit(self._method_name, args, kwargs)
         ref, aid = self._submit(args, kwargs)
         dead: set = set()  # populated by resubmit as deaths occur
         last = [aid]

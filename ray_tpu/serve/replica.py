@@ -47,6 +47,11 @@ class ServeReplica:
         # blocking-in-async).
         self._ongoing = 0
         self._total = 0
+        # requests pending/executing on the fast-path loop (single writer:
+        # the ReplicaFastPath drain thread; readers do GIL-atomic loads) —
+        # folded into the autoscaling stats push so channel-plane load
+        # drives the same scale signal as task-layer load
+        self._fp_ongoing = 0
         # sync handlers run here, NOT on the loop's default executor: the
         # default caps at min(32, cpus+4) threads, which would silently
         # cap sync concurrency below max_ongoing_requests (and starve
@@ -96,7 +101,7 @@ class ServeReplica:
             try:
                 if ctrl is None:
                     ctrl = _rt.get_actor("serve:controller")
-                ongoing = self._ongoing
+                ongoing = self._ongoing + self._fp_ongoing
                 if _metrics.ENABLED:
                     _M_REPLICA_ONGOING.set(
                         ongoing, {"deployment": str(self._identity[0])}
@@ -134,7 +139,8 @@ class ServeReplica:
 
     def stats(self) -> Dict[str, Any]:
         # runs on the loop thread, so both counters are read consistently
-        return {"ongoing": self._ongoing, "total": self._total}
+        return {"ongoing": self._ongoing, "total": self._total,
+                "fp_ongoing": self._fp_ongoing}
 
     def health_check(self) -> bool:
         if hasattr(self._callable, "check_health"):

@@ -91,6 +91,23 @@ def _key(tags: Optional[Dict[str, str]]) -> Tuple:
     return tuple(sorted((tags or {}).items()))
 
 
+def _rebuild_metric(cls, name, description, tag_keys, ctor_kwargs=None,
+                    default_tags=None):
+    """Unpickle hook (see Metric.__reduce__): resolve to the process's
+    existing registry entry — module import normally created it already —
+    and only construct a fresh one for a genuinely unknown name (carrying
+    the subclass-specific config, e.g. a Histogram's boundaries, so the
+    fallback doesn't silently bucket into defaults)."""
+    with _REGISTRY_LOCK:
+        existing = _REGISTRY.get(name)
+    if existing is not None:
+        return existing
+    m = cls(name, description, tag_keys=tag_keys, **(ctor_kwargs or {}))
+    if default_tags:
+        m.set_default_tags(default_tags)
+    return m
+
+
 class Metric:
     kind = "untyped"
 
@@ -103,6 +120,22 @@ class Metric:
         self._lock = threading.Lock()
         with _REGISTRY_LOCK:
             _REGISTRY[name] = self
+
+    def __reduce__(self):
+        # Metrics are process-global named singletons holding a lock — a
+        # by-value pickle is both impossible (the lock) and wrong (the
+        # target process must feed ITS registry). Reconstruct by
+        # (type, name): cloudpickle hits this when a class whose methods
+        # reference a module-level metric is shipped by value (e.g. the
+        # serve controller closing over the replica class in cluster mode).
+        return (_rebuild_metric,
+                (type(self), self.name, self.description, self.tag_keys,
+                 self._ctor_kwargs(), dict(self._default_tags) or None))
+
+    def _ctor_kwargs(self) -> dict:
+        """Subclass-specific constructor config to survive the pickle
+        round trip when the registry misses (Histogram: boundaries)."""
+        return {}
 
     def set_default_tags(self, tags: Dict[str, str]):
         self._default_tags = dict(tags)
@@ -219,6 +252,9 @@ class Histogram(Metric):
         self._sums: Dict[Tuple, float] = {}
         self._totals: Dict[Tuple, int] = {}
         self._exported: Dict[Tuple, list] = {}  # key -> [counts, sum, total]
+
+    def _ctor_kwargs(self) -> dict:
+        return {"boundaries": tuple(self.boundaries)}
 
     def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
         self.observe_k(_key(self._tags(tags)), value)

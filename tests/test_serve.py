@@ -396,4 +396,4 @@ def test_replica_request_counters_without_lock(ray8):
     info = ray_tpu.get(ctrl.get_replicas.remote("default", "Counted"))
     (replica,) = info["replicas"]
     stats = ray_tpu.get(replica.stats.remote())
-    assert stats == {"ongoing": 0, "total": n}
+    assert stats == {"ongoing": 0, "total": n, "fp_ongoing": 0}

@@ -1,0 +1,378 @@
+"""Serve fast path (ray_tpu.serve.fastpath): zero-RPC request plane on
+compiled-graph channels, continuous batching, and chaos behavior.
+
+Covers the ISSUE-12 acceptance gates: steady-state requests issue ZERO
+GCS RPCs (asserted via the flight recorder), a replica killed mid-request
+reroutes with exactly-once delivery under the invariant sanitizer (0
+trace violations including channel seq alternation), relay-mode pairs,
+idempotent teardown + GCS sweeps, and the adaptive batch sizer.
+"""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.cluster.cluster_utils import Cluster
+from ray_tpu.dag.channel import Channel, ChannelClosedError
+from ray_tpu.serve.batching import AdaptiveBatchSizer
+
+
+@pytest.fixture
+def fp_cluster():
+    """One-node embedded cluster with a long router-refresh period (the
+    zero-RPC assertions need a quiet background plane)."""
+    ray_tpu.shutdown()
+    cluster = Cluster()
+    cluster.add_node(num_cpus=4)
+    cluster.wait_for_nodes(1)
+    ray_tpu.init(address=cluster.address,
+                 config={"serve_fastpath_refresh_s": 60.0,
+                         "log_to_driver": False})
+    yield cluster
+    serve.shutdown()
+    ray_tpu.shutdown()
+    cluster.shutdown()
+
+
+# ============================================================== data path
+
+
+def test_fastpath_roundtrip_function_and_class(fp_cluster):
+    @serve.deployment(fast_path=True)
+    def echo(payload):
+        return {"echo": payload}
+
+    h = serve.run(echo.bind(), route_prefix=None)
+    assert h.remote({"x": 1}).result(timeout=30) == {"echo": {"x": 1}}
+
+    @serve.deployment(num_replicas=2, fast_path=True, name="Model")
+    class Model:
+        def __init__(self, scale):
+            self.scale = scale
+            self.n = 0
+
+        def __call__(self, x):
+            self.n += 1
+            return x * self.scale
+
+        def count(self):
+            return self.n
+
+    h2 = serve.run(Model.bind(10), name="m", route_prefix=None)
+    assert [h2.remote(i).result(timeout=30) for i in range(10)] \
+        == [i * 10 for i in range(10)]
+    # method-handle sugar rides the SAME router (shared channel pairs)
+    counts = [h2.count.remote().result(timeout=30) for _ in range(4)]
+    assert all(isinstance(c, int) and c >= 1 for c in counts)
+    st = h2.fastpath_stats()
+    assert st["completed"] == st["submitted"] >= 14
+    assert st["duplicates"] == 0 and st["failed"] == 0
+
+
+def test_fastpath_error_propagates_and_pipeline_survives(fp_cluster):
+    @serve.deployment(fast_path=True)
+    def boom(x):
+        if x == 13:
+            raise ValueError("boom13")
+        return x
+
+    h = serve.run(boom.bind(), route_prefix=None)
+    assert h.remote(1).result(timeout=30) == 1
+    with pytest.raises(Exception, match="boom13"):
+        h.remote(13).result(timeout=30)
+    # per-request error, not fatal to the plane
+    assert h.remote(2).result(timeout=30) == 2
+
+
+def test_fastpath_zero_gcs_rpcs_steady_state(fp_cluster):
+    """ISSUE-12 acceptance: steady-state request handling issues ZERO
+    RPCs from this driver — asserted via the always-on flight recorder
+    (every client send in this process lands in its ring)."""
+    from ray_tpu.cluster import rpc as _rpc
+    from ray_tpu.core import api as _api
+
+    @serve.deployment(num_replicas=2, fast_path=True)
+    def double(x):
+        return x * 2
+
+    h = serve.run(double.bind(), route_prefix=None)
+    for i in range(10):  # warm: pairs registered, channels mapped
+        assert h.remote(i).result(timeout=30) == i * 2
+    # drain stragglers (ref frees, controller chatter) out of the window
+    import gc
+
+    gc.collect()
+    time.sleep(1.0)
+    rec = _rpc.TRACE
+    assert rec is not None and getattr(rec, "is_flight_recorder", False), \
+        "test needs the default flight recorder installed"
+    me = _api._runtime.worker_id
+    before = len([e for e in rec.snapshot()
+                  if e[0] in ("send", "push") and e[2] == me])
+    for i in range(200):
+        assert h.remote(i).result(timeout=30) == i * 2
+    after = len([e for e in rec.snapshot()
+                 if e[0] in ("send", "push") and e[2] == me])
+    assert after == before, (
+        f"{after - before} driver RPC send(s) during 200 steady-state "
+        "fast-path requests — the hot path must be channel-only"
+    )
+    st = h.fastpath_stats()
+    assert st["completed"] >= 210 and st["duplicates"] == 0
+
+
+def test_fastpath_batch_handler_vectorized(fp_cluster):
+    """@serve.batch handlers get the continuous batcher's whole dispatch
+    group as ONE list call (no second rendezvous window)."""
+
+    @serve.deployment(fast_path=True, max_ongoing_requests=32)
+    class Batched:
+        def __init__(self):
+            self.sizes = []
+
+        @serve.batch(max_batch_size=32, batch_wait_timeout_s=0.05)
+        def __call__(self, xs):
+            self.sizes.append(len(xs))
+            return [x + 1 for x in xs]
+
+        def seen(self):
+            return list(self.sizes)
+
+    h = serve.run(Batched.bind(), route_prefix=None)
+    assert h.remote(1).result(timeout=30) == 2
+    n = 48
+    resps = [h.remote(i) for i in range(n)]
+    assert [r.result(timeout=30) for r in resps] == [i + 1 for i in range(n)]
+    sizes = h.seen.remote().result(timeout=30)
+    assert sum(sizes) >= n
+    assert max(sizes) > 1, (
+        f"concurrent submits never coalesced into a vectorized batch "
+        f"(sizes={sizes})"
+    )
+
+
+def test_fastpath_relay_mode_rides_daemon_transfer_path(fp_cluster):
+    """force_remote pairs use the dag_push/dag_pull relay — the
+    cross-node / remote-driver fallback — end to end."""
+    from ray_tpu.serve.fastpath import FastPathRouter
+
+    @serve.deployment(num_replicas=1, fast_path=True)
+    def triple(x):
+        return x * 3
+
+    h = serve.run(triple.bind(), route_prefix=None)
+    assert h.remote(1).result(timeout=30) == 3  # local-path sanity
+    router = FastPathRouter("triple", "default", h._fetch_membership,
+                            force_remote=True)
+    try:
+        router.refresh_now()
+        for i in range(5):
+            assert router.submit(None, (i,), {}).result(timeout=30) == i * 3
+        assert router.stats["completed"] == 5
+        assert router.stats["duplicates"] == 0
+    finally:
+        router.shutdown()
+
+
+# ================================================================== chaos
+
+
+def test_fastpath_replica_killed_mid_request(invariant_sanitizer,
+                                             monkeypatch):
+    """ISSUE-12 satellite: kill a replica worker mid-request. The router
+    must see ChannelClosedError (via the daemon death sweep's channel
+    poke), reroute the in-flight requests to the surviving replica, and
+    deliver each response exactly once — and the whole run must replay
+    clean through the invariant checker, channel seq alternation
+    included."""
+    ray_tpu.shutdown()
+    monkeypatch.setenv("RAY_TPU_TRACE_FILE", invariant_sanitizer.path)
+    cluster = Cluster()
+    cluster.add_node(num_cpus=4)
+    cluster.wait_for_nodes(1)
+    ray_tpu.init(address=cluster.address,
+                 config={"serve_fastpath_refresh_s": 60.0,
+                         "log_to_driver": False})
+    try:
+        @serve.deployment(num_replicas=2, fast_path=True,
+                          max_ongoing_requests=8)
+        def slow(x):
+            time.sleep(0.6)
+            return x + 1000
+
+        h = serve.run(slow.bind(), route_prefix=None)
+        assert h.remote(0).result(timeout=30) == 1000
+        # fire a volley, then kill a pair-attached replica mid-flight
+        resps = [h.remote(i) for i in range(6)]
+        time.sleep(0.25)
+        router = h._fp_router[0]
+        victim = None
+        attached = set(router._pairs)
+        for d in cluster.daemons:
+            for w in d.workers.values():
+                if w.serve_pairs and w.actor_id in attached:
+                    victim = w
+                    break
+            if victim:
+                break
+        assert victim is not None, "no pair-attached replica worker found"
+        victim.proc.kill()
+        got = [r.result(timeout=60) for r in resps]
+        assert got == [i + 1000 for i in range(6)]
+        st = h.fastpath_stats()
+        assert st["duplicates"] == 0, "a response was delivered twice"
+        assert st["failed"] == 0
+        assert st["rerouted"] >= 1, (
+            "the kill landed mid-request but nothing rerouted"
+        )
+        # the plane keeps serving afterwards
+        assert h.remote(7).result(timeout=60) == 1007
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_fastpath_node_kill_reroutes(monkeypatch):
+    """Kill a whole node hosting replicas: channels can't be poked (the
+    daemon died too) — the router's node-snapshot probe wakes parked
+    reads and requests land on surviving replicas."""
+    ray_tpu.shutdown()
+    cluster = Cluster()
+    cluster.add_node(num_cpus=4, resources={"KEEP": 10})
+    victim_node = cluster.add_node(num_cpus=4)
+    cluster.wait_for_nodes(2)
+    ray_tpu.init(address=cluster.address,
+                 config={"serve_fastpath_refresh_s": 60.0,
+                         "log_to_driver": False})
+    try:
+        @serve.deployment(num_replicas=3, fast_path=True)
+        def inc(x):
+            return x + 1
+
+        h = serve.run(inc.bind(), route_prefix=None)
+        for i in range(10):
+            assert h.remote(i).result(timeout=30) == i + 1
+        cluster.kill_node(victim_node)
+        # every request must still complete (reroute or already-healthy
+        # pair); allow the generous window the death sweep needs
+        deadline = time.time() + 60
+        done = 0
+        while done < 20 and time.time() < deadline:
+            assert h.remote(done).result(timeout=60) == done + 1
+            done += 1
+        assert done == 20
+        st = h.fastpath_stats()
+        assert st["duplicates"] == 0
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+# ============================================================== lifecycle
+
+
+def test_fastpath_teardown_idempotent_and_gcs_sweep(fp_cluster):
+    @serve.deployment(num_replicas=2, fast_path=True)
+    def f(x):
+        return x
+
+    h = serve.run(f.bind(), route_prefix=None)
+    assert h.remote(1).result(timeout=30) == 1
+    gcs = fp_cluster.gcs
+    assert gcs.serve_pairs, "pair registration never reached the GCS"
+    router = h._fp_router[0]
+    router.shutdown()
+    router.shutdown()  # idempotent
+    deadline = time.time() + 10
+    while time.time() < deadline and gcs.serve_pairs:
+        time.sleep(0.05)
+    assert not gcs.serve_pairs, "teardown left pair registrations behind"
+    # daemon channel index swept too (the teardown PUSH is async: give it
+    # its delivery window before asserting)
+    deadline = time.time() + 10
+    while time.time() < deadline and any(
+            d._serve_pairs for d in fp_cluster.daemons):
+        time.sleep(0.05)
+    for d in fp_cluster.daemons:
+        assert not d._serve_pairs
+
+
+def test_fastpath_driver_disconnect_sweeps_pairs(fp_cluster):
+    @serve.deployment(fast_path=True)
+    def f(x):
+        return x
+
+    h = serve.run(f.bind(), route_prefix=None)
+    assert h.remote(1).result(timeout=30) == 1
+    gcs = fp_cluster.gcs
+    assert gcs.serve_pairs
+    # driver vanishes WITHOUT teardown: the GCS sweeps its pairs
+    for r in list(__import__("ray_tpu.serve.fastpath",
+                             fromlist=["_ROUTERS"])._ROUTERS):
+        r._closed = True  # suppress the graceful teardown path
+    ray_tpu.shutdown()
+    deadline = time.time() + 20
+    while time.time() < deadline and gcs.serve_pairs:
+        time.sleep(0.1)
+    assert not gcs.serve_pairs, "GCS kept the dead driver's serve pairs"
+
+
+def test_fastpath_local_mode_falls_back_to_task_layer():
+    """fast_path=True in local mode (no cluster runtime) must serve
+    through the task layer rather than fail."""
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    try:
+        @serve.deployment(fast_path=True)
+        def f(x):
+            return x * 5
+
+        h = serve.run(f.bind(), route_prefix=None)
+        assert h.remote(2).result(timeout=10) == 10
+        assert h._fp_router[0] is None, "local mode must not build a router"
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+
+
+# ================================================================== units
+
+
+def test_channel_try_read_nonblocking(tmp_path):
+    path = str(tmp_path / "c.chan")
+    w = Channel.create(path, 64, "k")
+    r = Channel.open_wait(path, "k", timeout=5)
+    assert r.try_read() is None  # empty: no frame, no block
+    w.write(b"one")
+    assert r.try_read() == (1, b"one")
+    assert r.try_read() is None  # consumed
+    w.write(b"two")
+    w.close()
+    assert r.try_read() == (2, b"two")  # closed drains pending frames
+    with pytest.raises(ChannelClosedError):
+        r.try_read()  # closed AND drained
+
+
+def test_adaptive_batch_sizer_targets_latency():
+    s = AdaptiveBatchSizer(target_latency_s=0.1, max_batch=64)
+    assert s.target() == 64  # no signal: take what's queued (see target())
+    s.record(4, 0.04)  # 10ms per item -> ~10 items fit the target
+    assert 5 <= s.target() <= 12
+    for _ in range(50):
+        s.record(1, 0.0001)  # fast handler: EMA converges down
+    assert s.target() == 64  # clamped at max_batch
+    for _ in range(50):
+        s.record(1, 0.5)  # slow handler: latency-first
+    assert s.target() == 1
+    assert 0.0005 <= s.wait_budget() <= 0.025
+
+
+def test_adaptive_batch_sizer_ignores_empty():
+    s = AdaptiveBatchSizer(target_latency_s=0.02, max_batch=8)
+    s.record(0, 1.0)
+    assert s.target() == 8  # empty record ignored: still untrained
