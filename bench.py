@@ -1108,6 +1108,19 @@ def serve_storm_bench(duration_s=20.0, clients=48, replicas=3, seed=7):
     }
 
 
+def overload_storm_bench(seed=7):
+    """ISSUE-13 acceptance bench (recorded as BENCH_overload_rNN.json):
+    bursty open-loop traffic at 2-10x nominal capacity under chaos node
+    kills, A/B over the overload control plane. Bars: goodput with
+    control ON >= 3x the control-OFF arm AND >= 60% of the single-rate
+    peak, zero silently-unresolved submissions (every admitted task
+    terminally resolves — strict-terminal invariant-checked, admission
+    conservation included), offered load >= 2x saturation."""
+    from ray_tpu.scripts.overload_storm import run_storm
+
+    return run_storm(seed=seed)
+
+
 def _tpu_available(timeout_s: float = 120.0) -> bool:
     """Probe the TPU in a SUBPROCESS: a wedged axon tunnel hangs
     jax.devices() forever inside this process, which would take the whole
@@ -1196,6 +1209,23 @@ def main():
             "value": r["speedup"],
             "unit": "x (closed-loop goodput rps, same topology/workload)",
             "configs": {"serve_storm": r},
+        }))
+        return
+
+    if sys.argv[1:] == ["overload_storm"]:
+        # overload-control acceptance bench: bursty open-loop A/B storm
+        # — prints one JSON line (recorded as BENCH_overload_rNN.json);
+        # pure host python, no TPU probe
+        r = overload_storm_bench()
+        log(f"overload_storm ratio {r['goodput_ratio_on_off']}x, "
+            f"on {r['overload_on']['goodput_rps']} rps "
+            f"({r['on_frac_of_peak']} of peak), pass {r['storm_pass']}")
+        print(json.dumps({
+            "metric": "overload_goodput_ratio_on_off",
+            "value": r["goodput_ratio_on_off"],
+            "unit": "x (within-SLO goodput, control ON vs OFF, same "
+                    "seeded burst trace + chaos)",
+            "configs": {"overload_storm": r},
         }))
         return
 

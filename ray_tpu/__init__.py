@@ -42,6 +42,8 @@ from ray_tpu.core.exceptions import (
     TaskError,
     ActorError,
     ActorDiedError,
+    ClusterOverloadedError,
+    DeadlineExceededError,
     ObjectLostError,
     GetTimeoutError,
 )
@@ -69,6 +71,8 @@ __all__ = [
     "TaskError",
     "ActorError",
     "ActorDiedError",
+    "ClusterOverloadedError",
+    "DeadlineExceededError",
     "ObjectLostError",
     "GetTimeoutError",
 ]

@@ -24,6 +24,12 @@ retry/replay machinery of the reconnecting control plane must preserve:
   sequence numbers are strictly increasing;
 - **borrow conservation**: borrow releases never exceed registrations
   per (object, worker); optionally, terminal outstanding count is zero;
+- **admission conservation** (overload control plane): the GCS's
+  ``admit``/``admit_exit`` events — emitted at every queue enter/exit —
+  balance per task (an exit without an admit is a ledger bug), and in
+  ``strict_terminal`` mode every admitted task must have terminally
+  resolved (result, typed failure, or hand-back) by the end of the
+  trace: admission control may REJECT loudly, but never drop silently;
 - **object lifecycle**: an object location is only ever recorded after a
   store put on that node, and never re-surfaces after a free without an
   intervening re-creation (created -> sealed/put -> located -> freed);
@@ -73,6 +79,11 @@ METHOD_TABLE: Dict[str, str] = {
     "kill_actor": "actor lifetime-hold release",
     "actor_died": "actor lifetime-hold release",
     "stream_item": "object lifecycle (located)",
+    # overload control plane: admission enter/exit events pair at every
+    # queue transition (admission conservation — every admitted task
+    # terminally resolves), drain marks a node unschedulable while its
+    # running tasks bleed off
+    "drain_node": "node unschedulable marking (graceful drain)",
     # compiled DAGs (ray_tpu/dag): stage capacity holds follow the same
     # dispatch/release ledger as tasks; channel frames follow the per-edge
     # seq-alternation invariant (chan_write/chan_read apply events emitted
@@ -277,6 +288,9 @@ class InvariantChecker:
         self.actor_seq: Dict[Tuple, int] = {}
         # borrows: outstanding (oid, worker) registrations
         self.borrows: set = set()
+        # admission conservation: task -> net admit count (enter - exit);
+        # a duplicate submission legally sits at 2 until intake dedupes
+        self.admitted: Dict[str, int] = {}
         # object lifecycle: oid -> {"nodes": set, "freed": clock|None,
         #                           "put_after_free": bool}
         self.objects: Dict[str, Dict[str, Any]] = {}
@@ -366,6 +380,11 @@ class InvariantChecker:
                 self._bad("borrow", clock,
                           f"borrow {oid_worker!r} never released "
                           "(terminal count nonzero)")
+            for task in sorted(self.admitted):
+                self._bad("admission", clock,
+                          f"task {task} admitted but never terminally "
+                          "resolved (admission conservation: a silent "
+                          "drop or a stranded queue entry)")
         return self.violations
 
     def _on_node(self, ev: Dict) -> None:
@@ -503,6 +522,31 @@ class InvariantChecker:
                       "(submission-order execution broken)")
         else:
             self.actor_seq[key] = int(seq)
+
+    # --- admission conservation (overload control plane) ---
+
+    def _on_admit(self, ev: Dict) -> None:
+        t = ev["task"]
+        self.admitted[t] = self.admitted.get(t, 0) + 1
+
+    def _on_admit_exit(self, ev: Dict) -> None:
+        t = ev["task"]
+        n = self.admitted.get(t, 0) - 1
+        if n < 0:
+            self._bad("admission", ev["c"],
+                      f"task {t} exited the admission ledger without a "
+                      "matching admit (exit-without-admit)")
+            self.admitted.pop(t, None)
+        elif n == 0:
+            self.admitted.pop(t, None)
+        else:
+            self.admitted[t] = n
+
+    def _on_admit_reject(self, ev: Dict) -> None:
+        pass  # typed rejection: terminal by construction, never admitted
+
+    def _on_node_drain(self, ev: Dict) -> None:
+        pass  # informational; capacity semantics ride release events
 
     def _on_borrow_reg(self, ev: Dict) -> None:
         self.borrows.add((ev["oid"], ev.get("worker")))

@@ -30,6 +30,7 @@ import threading
 import time
 import traceback
 import uuid
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -195,7 +196,8 @@ class AutoscalerV2:
                  idle_timeout_s: float = 5.0,
                  update_interval_s: float = 0.5,
                  allocation_timeout_s: float = 60.0,
-                 launch_retries: int = 2):
+                 launch_retries: int = 2,
+                 launch_workers: int = 2):
         self.gcs = RpcClient(gcs_addr[0], gcs_addr[1])
         self.provider = provider
         self.node_types = {nt.name: nt for nt in node_types}
@@ -207,6 +209,17 @@ class AutoscalerV2:
         self.space = ResourceSpace()
         self._retries: Dict[str, int] = {}  # instance_id -> retries left
         self._idle_since: Dict[str, float] = {}  # ray node_id -> ts
+        # provider.create_node runs OFF the reconciler tick (reference:
+        # the v2 launcher's background thread pool): one hanging cloud
+        # call must not stall reconcile/sizing/drain. REQUESTED models
+        # the in-flight launch; results land here and reconcile on a
+        # later tick.
+        self._launch_pool = ThreadPoolExecutor(
+            max_workers=launch_workers, thread_name_prefix="as-launch"
+        )
+        self._launch_lock = threading.Lock()
+        # (instance_id, cloud_id | Exception) completions to reconcile
+        self._launch_results: List[tuple] = []
         self._stopped = False
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="autoscaler-v2"
@@ -223,6 +236,7 @@ class AutoscalerV2:
 
     def shutdown(self):
         self._stopped = True
+        self._launch_pool.shutdown(wait=False)
         try:
             self.gcs.close()
         except Exception:  # noqa: BLE001
@@ -294,36 +308,65 @@ class AutoscalerV2:
                     )
                     self._terminate(inst)
 
+    def _do_launch(self, instance_id: str, node_type: str,
+                   resources: Dict[str, float]) -> None:
+        """Pool thread: ONE provider call; the outcome (cloud id or the
+        exception) is reconciled by a later tick. A provider that hangs
+        pins only this pool thread — the reconciler keeps ticking."""
+        try:
+            outcome = self.provider.create_node(node_type, resources)
+        except Exception as e:  # noqa: BLE001 - provider fault
+            outcome = e
+        with self._launch_lock:
+            self._launch_results.append((instance_id, outcome))
+
     def _launch_queued(self):
+        # reconcile completed background launches first
+        with self._launch_lock:
+            done, self._launch_results = self._launch_results, []
+        for iid, outcome in done:
+            inst = self.im.get(iid)
+            if inst is None or inst.status != InstanceStatus.REQUESTED:
+                # terminated/cleaned up while the launch was in flight:
+                # the cloud node (if any) is reaped by reconcile against
+                # provider.non_terminated_nodes on later ticks
+                continue
+            if isinstance(outcome, Exception):
+                # launch-retry budget CARRIES to the replacement record
+                # (*_FAILED is terminal, so the retry is a fresh record):
+                # a persistently failing provider exhausts the budget
+                # instead of retrying forever and growing the tables
+                # without bound
+                left = self._retries.pop(iid, self.launch_retries)
+                if left > 0:
+                    self.im.update_status(
+                        iid, InstanceStatus.ALLOCATION_FAILED,
+                        f"{outcome!r} (will retry, {left - 1} left after "
+                        "the replacement)",
+                    )
+                    new = self.im.create_instance(
+                        inst.node_type, inst.resources
+                    )
+                    self._retries[new.instance_id] = left - 1
+                else:
+                    self.im.update_status(
+                        iid, InstanceStatus.ALLOCATION_FAILED,
+                        f"{outcome!r} (retries exhausted)",
+                    )
+                continue
+            self._retries.pop(iid, None)  # budget no longer needed
+            inst.cloud_node_id = outcome
+            self.im.update_status(
+                iid, InstanceStatus.ALLOCATED, outcome
+            )
+        # dispatch new launches to the pool; REQUESTED models in-flight
         for inst in self.im.instances({InstanceStatus.QUEUED}):
             self.im.update_status(
                 inst.instance_id, InstanceStatus.REQUESTED, "launching"
             )
-            try:
-                cloud_id = self.provider.create_node(
-                    inst.node_type, inst.resources
-                )
-            except Exception as e:  # noqa: BLE001 - provider fault
-                left = self._retries.get(
-                    inst.instance_id, self.launch_retries
-                )
-                if left > 0:
-                    self._retries[inst.instance_id] = left - 1
-                    # re-queue through a fresh record: *_FAILED is terminal
-                    self.im.update_status(
-                        inst.instance_id, InstanceStatus.ALLOCATION_FAILED,
-                        f"{e!r} (will retry)",
-                    )
-                    self.im.create_instance(inst.node_type, inst.resources)
-                else:
-                    self.im.update_status(
-                        inst.instance_id, InstanceStatus.ALLOCATION_FAILED,
-                        f"{e!r} (retries exhausted)",
-                    )
-                continue
-            inst.cloud_node_id = cloud_id
-            self.im.update_status(
-                inst.instance_id, InstanceStatus.ALLOCATED, cloud_id
+            self._launch_pool.submit(
+                self._do_launch, inst.instance_id, inst.node_type,
+                dict(inst.resources),
             )
 
     # ------------------------------------------------------------- sizing
@@ -390,11 +433,29 @@ class AutoscalerV2:
             if nt is None or counts.get(inst.node_type, 0) <= nt.min_workers:
                 continue
             if now - self._idle_since[inst.instance_id] > self.idle_timeout_s:
+                # GCS-side drain BEFORE entering RAY_STOPPING: the node
+                # is marked unschedulable server-side, so a task
+                # dispatched between this tick's idle observation and
+                # the terminate can no longer land on it — the
+                # scale-down race is closed at the scheduler, not
+                # papered over by task retries. Running tasks bleed off;
+                # the reconciler terminates only once running == 0.
+                # Drain state mutates only AFTER the call succeeds: a
+                # failed/timed-out drain keeps the idle clock, so the
+                # retry happens next tick (drain_node is idempotent —
+                # a lost reply just re-drains).
+                try:
+                    self.gcs.call(
+                        "drain_node", {"node_id": inst.ray_node_id},
+                        timeout=5.0,
+                    )
+                except Exception:  # noqa: BLE001 - node/GCS mid-churn
+                    continue  # retry the drain next tick, stay RUNNING
                 counts[inst.node_type] -= 1
                 self._idle_since.pop(inst.instance_id, None)
                 self.im.update_status(
                     inst.instance_id, InstanceStatus.RAY_STOPPING,
-                    "idle past timeout",
+                    "idle past timeout (drained in GCS)",
                 )
 
     def _terminate(self, inst: Instance):

@@ -26,6 +26,7 @@ from ray_tpu.core import serialization
 from ray_tpu.core.config import Config
 from ray_tpu.core.exceptions import (
     ActorDiedError,
+    ClusterOverloadedError,
     GetTimeoutError,
     ObjectLostError,
     TaskError,
@@ -166,6 +167,13 @@ class ClusterClient:
         # {"state", "error"}; CompiledDAG.execute polls it so a dead
         # pipeline raises ChannelClosedError instead of parking forever
         self._dag_states: Dict[str, dict] = {}
+        # --- overload control plane (client half) ---
+        # last advisory throttle push from the GCS ("overload" channel);
+        # replaced wholesale (atomic assignment) so readers never lock
+        self._overload = {"overloaded": False, "retry_after": 0.0, "ts": 0.0}
+        # admission-rejected tasks parked for a paced resubmission:
+        # (not_before, meta), drained by the gc thread's 0.1s tick
+        self._paced: List[tuple] = []
         # error-object publication queue: one shared publisher thread (see
         # _publish_error); entries are (refs, payload, deadline)
         self._err_pub_q: list = []
@@ -206,6 +214,7 @@ class ClusterClient:
         self.gcs.subscribe("borrow_added", self._on_borrow_added)
         self.gcs.subscribe("borrow_released", self._on_borrow_released)
         self.gcs.subscribe("worker_logs", self._on_worker_logs)
+        self.gcs.subscribe("overload", self._on_overload)
         self.gcs.subscribe("dag_update", self._on_dag_update)
         self.gcs.connect()
         self._put_rr = 0
@@ -355,6 +364,22 @@ class ClusterClient:
         UpdateFinishedTaskReferences; batched here to amortize the RPC)."""
         while not self._closed:
             time.sleep(0.1)
+            # paced admission retries (overload control plane): resubmit
+            # every parked meta whose retry_after elapsed — runs here so
+            # rejected tasks need no thread of their own
+            due = []
+            with self._lock:
+                if self._paced:
+                    now = time.time()
+                    still = []
+                    for nb, meta in self._paced:
+                        (due if nb <= now else still).append((nb, meta))
+                    self._paced = still
+            for _nb, meta in due:
+                try:
+                    self._submit_async(meta)
+                except Exception:  # noqa: BLE001 - reconnect plane owns it
+                    pass
             batch = []
             while self._gc_queue:
                 batch.append(self._gc_queue.popleft())
@@ -427,7 +452,7 @@ class ClusterClient:
         for meta in unfinished:
             try:
                 self._refresh_inflight_deps(meta)
-                gcs.call("submit_task", meta, timeout=timeout)
+                self._submit_blocking(gcs, meta, timeout)
             except Exception:
                 pass
 
@@ -453,6 +478,98 @@ class ClusterClient:
                 )
             except Exception:  # noqa: BLE001
                 pass
+
+    # ------------------------------------------------ overload control
+
+    def _on_overload(self, p: dict) -> None:
+        """GCS advisory throttle push (backpressure propagation): the
+        cluster overload state derived from queue depth + daemon
+        saturation. Pacing submitters consult it in _maybe_pace."""
+        self._overload = {
+            "overloaded": bool(p.get("overloaded")),
+            "retry_after": float(p.get("retry_after") or 0.25),
+            "ts": time.time(),
+        }
+
+    def overload_state(self) -> dict:
+        """Snapshot of the last advisory overload push (tests/tooling)."""
+        return dict(self._overload)
+
+    def _maybe_pace(self) -> None:
+        """Optional client-side pacing: while the GCS advertises
+        overload AND this driver already has admission-rejected tasks
+        parked for retry (i.e. it is demonstrably over its quota —
+        pacing a driver that still has admission headroom would throttle
+        it below the admitted rate), slow the submitter down by the
+        advertised hint. Open-loop producers degrade to the admitted
+        rate; throughput is sustained by the paced retries refilling
+        freed slots. Bounded (<= 0.25s per submission) and only from
+        user submit threads, never from rpc reader threads. Stale pushes
+        (no re-broadcast within 5s — e.g. across a GCS restart) stop
+        pacing on their own."""
+        if not self.config.admission_pacing_enabled:
+            return
+        ov = self._overload
+        if not (ov["overloaded"] and time.time() - ov["ts"] < 5.0):
+            return
+        with self._lock:
+            over_quota = bool(self._paced)
+        if over_quota:
+            time.sleep(min(ov["retry_after"], 0.25))
+
+    def _on_admission_reject(self, meta: dict, reply: dict) -> None:
+        """A submit_task was refused by the GCS admission controller
+        (typed, retryable — never a silent drop). With pacing enabled,
+        park the meta for a delayed resubmission (budgeted by
+        admission_pacing_max_s); otherwise (or once the budget is spent)
+        the task's refs fail with ClusterOverloadedError, which ray.get
+        raises to the caller. Runs on the rpc reader thread — no
+        blocking work here."""
+        retry_after = float(
+            reply.get("retry_after") or self.config.admission_retry_after_s
+        )
+        now = time.time()
+        self._overload = {
+            "overloaded": True, "retry_after": retry_after, "ts": now,
+        }
+        deadline = meta.get("_adm_deadline")
+        if deadline is None:
+            deadline = now + self.config.admission_pacing_max_s
+            meta["_adm_deadline"] = deadline
+        if (
+            self.config.admission_pacing_enabled
+            and now + retry_after < deadline
+        ):
+            # capped exponential backoff per task: a large parked set
+            # must not hammer the GCS with a reject storm every
+            # retry_after window; slots freed by completions are
+            # refilled by whichever parked tasks come due next
+            tries = meta.get("_adm_tries", 0)
+            meta["_adm_tries"] = tries + 1
+            delay = retry_after * min(2 ** tries, 16)
+            with self._lock:
+                self._paced.append((now + delay, meta))
+            return
+        err = ClusterOverloadedError(
+            f"task {meta['task_id'][:12]} rejected by the cluster "
+            f"admission controller ({reply.get('error')}); retry after "
+            f"{retry_after}s",
+            retry_after_s=retry_after,
+        )
+        self._gc_queue.append(("fail_submit", (meta, err)))
+
+    def _submit_blocking(self, gcs, meta: dict, timeout: float) -> dict:
+        """Blocking submit_task that HONORS admission rejections: the
+        reconnect-resubmit and lineage-repair paths must never drop a
+        refused task on the floor — a rejection routes into the same
+        pace-or-typed-fail machinery as the async path."""
+        reply = gcs.call("submit_task", meta, timeout=timeout)
+        if isinstance(reply, dict) and reply.get("overloaded"):
+            self._on_admission_reject(meta, reply)
+        else:
+            meta.pop("_adm_deadline", None)
+            meta.pop("_adm_tries", None)
+        return reply
 
     # ----------------------------------------------------------- submission
 
@@ -493,6 +610,10 @@ class ClusterClient:
         with self._lock:
             self._task_meta[spec.task_id] = meta
         self._track_submission(spec.task_id, meta, refs)
+        if not spec.actor_creation:
+            # advisory throttle (overload control plane): normal-task
+            # submitters pace while the GCS advertises overload
+            self._maybe_pace()
         self._submit_async(meta)
         return refs
 
@@ -533,6 +654,17 @@ class ClusterClient:
             except Exception:  # noqa: BLE001 - cancelled
                 return
             if exc is None:
+                reply = fut.result()
+                if isinstance(reply, dict) and reply.get("overloaded"):
+                    # typed admission rejection: pace-and-retry or fail
+                    # the refs with ClusterOverloadedError — either way
+                    # the submission terminally resolves
+                    self._on_admission_reject(meta, reply)
+                else:
+                    # accepted: a stale pacing deadline must not
+                    # insta-fail an unrelated rejection much later
+                    meta.pop("_adm_deadline", None)
+                    meta.pop("_adm_tries", None)
                 return
             if isinstance(exc, ConnectionLost) and not (
                 meta.get("actor_creation") or meta.get("actor_id")
@@ -1025,7 +1157,12 @@ class ClusterClient:
             ObjectRef.for_task_output(task_id, i, owner=self.worker_id)
             for i in range(meta.get("num_returns", 1))
         ]
-        err = TaskError(f"task failed after retries: {error}")
+        # a pre-typed exception (e.g. ClusterOverloadedError) passes
+        # through so ray.get raises the specific, retryable type
+        err = (
+            error if isinstance(error, BaseException)
+            else TaskError(f"task failed after retries: {error}")
+        )
         for r in refs:
             self.store.put(r, err, is_exception=True)
         # publish the error as the objects themselves so tasks waiting on
@@ -1083,8 +1220,9 @@ class ClusterClient:
                         self._reconstructing.add(ptid)
                     try:
                         self._refresh_inflight_deps(pmeta)
-                        self.gcs.call("submit_task", pmeta,
-                                      timeout=self._rpc_timeout)
+                        self._submit_blocking(
+                            self.gcs, pmeta, self._rpc_timeout
+                        )
                     except Exception:
                         # leave the door open for a later repair attempt
                         with self._lock:
@@ -1102,7 +1240,7 @@ class ClusterClient:
                 meta["_dep_refunds"] = meta.get("_dep_refunds", 0) + 1
                 meta["retries_left"] = meta.get("retries_left", 0) + 1
             self._refresh_inflight_deps(meta)
-            self.gcs.call("submit_task", meta, timeout=self._rpc_timeout)
+            self._submit_blocking(self.gcs, meta, self._rpc_timeout)
         except Exception as e:  # noqa: BLE001
             self._fail_task_refs(meta["task_id"], meta, f"lineage repair: {e!r}")
 
@@ -1326,8 +1464,7 @@ class ClusterClient:
                 if meta is not None:
                     # result will arrive via the normal task_result push
                     self.store.delete([ref])
-                    self.gcs.call("submit_task", meta,
-                                  timeout=self._rpc_timeout)
+                    self._submit_blocking(self.gcs, meta, self._rpc_timeout)
                     return self._get_one(ref, deadline)
             time.sleep(0.05)
         raise ObjectLostError(f"object {ref.id[:8]} could not be retrieved")

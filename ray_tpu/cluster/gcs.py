@@ -62,6 +62,16 @@ _M_SCHED_PENDING = _metrics.Gauge(
     "ray_tpu_gcs_sched_pending_tasks",
     "queued-but-undispatched tasks at the GCS after intake",
 )
+_M_ADMIT_REJECT = _metrics.Counter(
+    "ray_tpu_gcs_admission_rejects_total",
+    "submissions refused by the per-driver admission controller "
+    "(typed retryable rejection, never a silent drop)",
+)
+_M_OVERLOADED = _metrics.Gauge(
+    "ray_tpu_gcs_overloaded",
+    "derived cluster overload state (1 while the advisory throttle "
+    "push is active)",
+)
 # per-method handler series keys, built once (see util/metrics.series_key)
 _HANDLER_KEYS: Dict[str, tuple] = {}
 
@@ -174,6 +184,22 @@ class GcsServer:
         # whose delta payload is not idempotent); mutated only inside
         # rpc_heartbeat on the rpc loop
         self._metrics_seq_seen: Dict[str, int] = {}
+
+        # --- overload control plane (README "Overload control") ---
+        # admission ledger: owner driver_id -> tasks currently IN the
+        # system (queued + dep-waiting + running); maintained by
+        # _track_enter/_track_exit so it is conservation-paired with the
+        # queues by construction. rpc_submit_task bounds it per driver
+        # (admission_max_pending_per_driver) with a typed retryable
+        # rejection — excess load is pushed back, never queued unbounded.
+        self._admitted: Dict[str, int] = {}
+        # nodes marked unschedulable by rpc_drain_node (graceful drain
+        # before an autoscaler terminate); mirrored into state.draining
+        self._draining: set = set()
+        # derived cluster overload state (hysteresis; see
+        # _overload_check) + last advisory-throttle broadcast time
+        self._overloaded = False
+        self._overload_last_push = 0.0
 
         # --- scheduler state ---
         # intake: raw submissions, vetted once per round by _intake_locked
@@ -374,6 +400,9 @@ class GcsServer:
                 # stale high-water marker would discard the fresh
                 # instance's deltas until its counter caught up
                 self._metrics_seq_seen.pop(node_id, None)
+                # a drain applies to one node INCARNATION: the fresh
+                # daemon process starts schedulable again
+                self._draining.discard(node_id)
             self.nodes[node_id] = {
                 "node_id": node_id,
                 "addr": p["addr"],
@@ -386,6 +415,7 @@ class GcsServer:
                 "shm_name": p.get("shm_name"),
                 "instance": p.get("instance"),
                 "chan_dir": p.get("chan_dir"),
+                "draining": node_id in self._draining,
             }
             # recorded only after the entry commits (a malformed payload
             # must not leave an event for a node that never joined); rejoin
@@ -398,6 +428,11 @@ class GcsServer:
             revived = True
             if idx is None:
                 self.state.add_node(node_id, p["resources"], p.get("labels"))
+            elif node_id in self._draining:
+                # a draining row reads alive=False but its debits are
+                # live — a connection bounce must not revive (and reset)
+                # it out from under the running tasks bleeding off
+                revived = False
             elif not self.state.alive[idx]:
                 # re-registration after a death: revive the scheduler row
                 self.state.revive_node(node_id, p["resources"])
@@ -467,6 +502,11 @@ class GcsServer:
                     # per-node physical stats (reporter-agent analog);
                     # served through get_nodes / the dashboard node table
                     n["stats"] = p["stats"]
+                if p.get("load") is not None:
+                    # backpressure signal riding the beat: the daemon's
+                    # task-queue depth + worker saturation, folded into
+                    # the cluster overload derivation (_overload_check)
+                    n["load"] = p["load"]
         m = p.get("metrics")
         if m:
             # delta snapshot of the node's (daemon + its workers') metric
@@ -488,9 +528,59 @@ class GcsServer:
             return {
                 nid: {k: n.get(k) for k in
                       ("addr", "port", "resources", "alive", "labels",
-                       "shm_name", "stats")}
+                       "shm_name", "stats", "draining", "load")}
                 for nid, n in self.nodes.items()
             }
+
+    def rpc_drain_node(self, p, conn):
+        """Mark a node unschedulable (graceful drain) so its running tasks
+        bleed off before the autoscaler's terminate — closing the
+        scale-down race where a task dispatched between the idle
+        observation and the provider terminate landed on a node about to
+        die (reference: the DrainNode RPC in gcs_node_manager.cc). The
+        node stays alive and heartbeating; nothing new is placed on it;
+        ``undrain`` reverses the mark (demand returned before terminate).
+        Idempotent. Returns the node's current running count so callers
+        can poll the bleed."""
+        from ray_tpu.util.events import record_event
+
+        with self._lock:
+            node_id = p["node_id"]
+            n = self.nodes.get(node_id)
+            if n is None:
+                return {"ok": False, "error": f"unknown node {node_id}"}
+            if p.get("undrain"):
+                if node_id in self._draining:
+                    self._draining.discard(node_id)
+                    n["draining"] = False
+                    if n.get("alive"):
+                        self.state.undrain_node(node_id)
+                    self._pg_retry_needed = True
+                    if rpc_mod.TRACE is not None:
+                        rpc_mod.TRACE.apply(
+                            "node_drain", node=node_id, undrain=True
+                        )
+            elif node_id not in self._draining:
+                self._draining.add(node_id)
+                n["draining"] = True
+                if n.get("alive"):
+                    self.state.drain_node(node_id)
+                record_event(
+                    "NODE_DRAINING",
+                    f"node {node_id} marked unschedulable (drain)",
+                    source="gcs", node_id=node_id,
+                )
+                if rpc_mod.TRACE is not None:
+                    rpc_mod.TRACE.apply(
+                        "node_drain", node=node_id, undrain=False
+                    )
+            running = sum(
+                1 for info in self.running.values()
+                if info["node_id"] == node_id
+            )
+            draining = node_id in self._draining
+        self._kick()
+        return {"ok": True, "running": running, "draining": draining}
 
     def rpc_register_driver(self, p, conn):
         with self._lock:
@@ -529,11 +619,45 @@ class GcsServer:
         actor_id?, actor_creation?, num_returns, strategy}."""
         with self._lock:
             tid = p["task_id"]
-            if tid in self.running or tid in self.waiting_tasks:
+            if (
+                tid in self.running or tid in self.waiting_tasks
+                or tid in self._queued_ids
+            ):
                 # duplicate resubmission (e.g. two consumers reconstructing
-                # one producer): running it twice would leak the first
-                # dispatch's resource hold when the second overwrites it
+                # one producer, or a reconnect replay of a still-QUEUED
+                # task): running it twice would leak the first dispatch's
+                # resource hold when the second overwrites it — and a
+                # still-queued task's replay must dedupe here rather than
+                # burn (or get rejected by) its owner's admission quota
                 return {"ok": True, "duplicate": True}
+            # --- admission controller (README "Overload control"):
+            # bounded per-driver in-system ledger. Over the bound the
+            # submission is REFUSED with a typed retryable reply — the
+            # client paces and retries or surfaces ClusterOverloadedError;
+            # the task never enters the queues, so backlog (and GCS
+            # memory) stays bounded per driver instead of collapsing the
+            # control plane at overload. Actor creations are exempt
+            # (few, lifetime-scoped, and their kill path is separate).
+            limit = int(self.config.admission_max_pending_per_driver)
+            owner = p.get("owner")
+            if (
+                limit > 0 and not p.get("actor_creation")
+                and self._admitted.get(owner, 0) >= limit
+            ):
+                if _metrics.ENABLED:
+                    _M_ADMIT_REJECT.inc()
+                if rpc_mod.TRACE is not None:
+                    rpc_mod.TRACE.apply(
+                        "admit_reject", task=tid, owner=owner
+                    )
+                return {
+                    "ok": False,
+                    "overloaded": True,
+                    "retry_after": self.config.admission_retry_after_s,
+                    "pending": self._admitted.get(owner, 0),
+                    "error": f"driver {owner} is at its admission bound "
+                             f"({limit} in-system tasks)",
+                }
             p["owner_conn"] = conn.conn_id
             p["enqueued_at"] = self._rt.now()
             if p.get("actor_creation"):
@@ -610,12 +734,32 @@ class GcsServer:
         return out
 
     def _track_enter(self, meta: dict) -> None:
-        """A task entered the system (pending/waiting). Caller holds _lock."""
+        """A task entered the system (pending/waiting). Caller holds _lock.
+        Also charges the owner's admission ledger and emits the ``admit``
+        trace event — enter/exit are called symmetrically at every queue
+        transition, so the ledger (and the admission-conservation
+        invariant the checker replays) is balanced by construction."""
+        tid = meta.get("task_id")
+        if tid:
+            owner = meta.get("owner")
+            self._admitted[owner] = self._admitted.get(owner, 0) + 1
+            if rpc_mod.TRACE is not None:
+                rpc_mod.TRACE.apply("admit", task=tid, owner=owner)
         for oid in self._outputs_of(meta):
             self.active_outputs[oid] += 1
 
     def _track_exit(self, meta: dict) -> None:
         """A task left the system (done/failed/dropped). Caller holds _lock."""
+        tid = meta.get("task_id")
+        if tid:
+            owner = meta.get("owner")
+            left = self._admitted.get(owner, 0) - 1
+            if left > 0:
+                self._admitted[owner] = left
+            else:
+                self._admitted.pop(owner, None)
+            if rpc_mod.TRACE is not None:
+                rpc_mod.TRACE.apply("admit_exit", task=tid, owner=owner)
         for oid in self._outputs_of(meta):
             n = self.active_outputs.get(oid)
             if n is not None:
@@ -1849,6 +1993,63 @@ class GcsServer:
             + len(self._special_queue)
         )
 
+    def _overload_check(self):
+        """Derive the cluster overload state (queued work at the GCS plus
+        daemon-reported task-queue depths, against total CPU capacity,
+        with hysteresis) and decide whether an advisory ``overload`` push
+        is due: on every transition, and re-broadcast ~1/s while
+        overloaded so late-registering/reconnecting drivers learn it.
+        Returns (payload, driver_conn_ids) or None. The push is ADVISORY
+        throttle — pacing clients slow their submitters down; the hard
+        backstop is the admission controller in rpc_submit_task."""
+        now = self._rt.now()
+        with self._lock:
+            queued = self.pending_task_count()
+            for n in self.nodes.values():
+                if n.get("alive"):
+                    queued += int((n.get("load") or {}).get("queued", 0))
+            cpu_i = self.space.index("CPU")
+            cpus = 0.0
+            if cpu_i is not None and len(self.state.alive):
+                cpus = float(
+                    self.state.total[self.state.alive, cpu_i].sum()
+                )
+            base = max(cpus, 1.0)
+            was = self._overloaded
+            if not was and queued > \
+                    self.config.overload_pending_high_per_cpu * base:
+                self._overloaded = True
+            elif was and queued < \
+                    self.config.overload_pending_low_per_cpu * base:
+                self._overloaded = False
+            changed = self._overloaded != was
+            due = self._overloaded and \
+                now - self._overload_last_push > 1.0
+            if not (changed or due):
+                return None
+            self._overload_last_push = now
+            payload = {
+                "overloaded": self._overloaded,
+                "retry_after": self.config.admission_retry_after_s,
+                "queued": int(queued),
+            }
+            targets = {
+                d["conn"].conn_id for d in self.drivers.values()
+            }
+        if _metrics.ENABLED:
+            _M_OVERLOADED.set(1.0 if payload["overloaded"] else 0.0)
+        return payload, targets
+
+    def _push_overload(self) -> None:
+        ov = self._overload_check()
+        if ov is None:
+            return
+        payload, targets = ov
+        self.server.broadcast(
+            "overload", payload,
+            filter_fn=lambda c: c.conn_id in targets,
+        )
+
     def _schedule_round(self):
         """Reference hot path reformulated: intake once, then per round one
         batched kernel call over per-class queue DEPTHS -> dispatch pushes.
@@ -1873,6 +2074,7 @@ class GcsServer:
             self._spawn_pg_finalizers(pg_work)
             for t, lost in deps_lost_round:
                 self._push_deps_lost(t, lost)
+            self._push_overload()
             return
         with self._lock:
             keys = [
@@ -2000,6 +2202,7 @@ class GcsServer:
                 self._push_conn(target, "task_result", payload)
         for t, lost in deps_lost_round:
             self._push_deps_lost(t, lost)
+        self._push_overload()
         if _metrics.ENABLED:
             _M_SCHED_ROUND.observe(time.perf_counter() - t0)
             _M_DISPATCH_BATCH.observe(len(dispatches))
@@ -2277,6 +2480,7 @@ class GcsServer:
                          severity="WARNING", source="gcs",
                          node_id=node_id, cause=cause)
             n["alive"] = False
+            self._draining.discard(node_id)  # a dead node needs no drain
             self.state.remove_node(node_id)
             # the node's serve fast-path pairs died with it: drop the
             # registrations (clients detect the death through their node

@@ -1730,6 +1730,16 @@ class NodeDaemon:
             if beats % 5 == 0:  # physical stats every ~5th beat (psutil
                 payload["stats"] = self._sample_stats()  # calls are cheap
             beats += 1                                   # but not free)
+            # backpressure signal (overload control plane): task-queue
+            # depth + worker saturation fold into the GCS's cluster
+            # overload derivation every beat (plain len() reads — the
+            # heartbeat thread already samples these fields lock-free
+            # for the gauges below)
+            payload["load"] = {
+                "queued": len(self._task_queue),
+                "idle": len(self._idle),
+                "workers": len(self.workers),
+            }
             if _metrics.ENABLED:
                 # metric export rides the beat: this process's registry
                 # delta + any deltas local workers pushed since last time.

@@ -49,9 +49,18 @@ class ActorEventLoop:
     """A per-actor asyncio loop on a dedicated daemon thread, with a
     blocking bridge for the actor's dispatch threads."""
 
+    #: bound on the post-stop drain: a coroutine that catches
+    #: CancelledError and keeps awaiting must not wedge the loop thread
+    #: (and with it every dispatch thread blocked in call()) forever
+    DRAIN_TIMEOUT_S = 5.0
+
     def __init__(self, name: str):
         self.loop = asyncio.new_event_loop()
         self._closed = False
+        # wall-clock bound past which call() treats the actor as dead
+        # even though the loop thread is still alive (a stubborn
+        # coroutine riding out the drain window); set by shutdown()
+        self._dead_at = None
         self._thread = threading.Thread(
             target=self._run, daemon=True, name=name
         )
@@ -62,7 +71,9 @@ class ActorEventLoop:
         self.loop.run_forever()
         # Drain before close. Two distinct leftovers exist after stop():
         # 1) tasks that survived cancellation (caught CancelledError and
-        #    kept awaiting) — gather them;
+        #    kept awaiting) — wait for them, BOUNDED: asyncio.wait with a
+        #    timeout (NOT wait_for/gather-cancel, which would block until
+        #    the stubborn task acknowledges a cancellation it swallows);
         # 2) done-callbacks of tasks that were cancelled DURING shutdown:
         #    a task's done-callback (which resolves the caller's bridge
         #    future in run_coroutine_threadsafe's chaining) is call_soon-
@@ -73,11 +84,14 @@ class ActorEventLoop:
             pending = asyncio.all_tasks(self.loop)
             if pending:
                 self.loop.run_until_complete(
-                    asyncio.gather(*pending, return_exceptions=True)
+                    asyncio.wait(pending, timeout=self.DRAIN_TIMEOUT_S)
                 )
             self.loop.run_until_complete(asyncio.sleep(0))
         finally:
-            self.loop.close()
+            try:
+                self.loop.close()
+            except RuntimeError:
+                pass  # a still-pending stubborn task; the thread exits
 
     def call(self, method: Callable, args: tuple, kwargs: dict) -> Any:
         """Run a user method on the loop from a dispatch thread, blocking
@@ -104,12 +118,27 @@ class ActorEventLoop:
         # actor's death instead of wedging forever.
         import concurrent.futures as _cf
 
+        import time as _time
+
         while True:
             try:
                 return fut.result(timeout=0.5)
             except _cf.TimeoutError:
-                if self._closed and not self._thread.is_alive():
-                    fut.cancel()
+                # (closed + thread dead) OR (closed + the shutdown grace
+                # window expired): either way the loop will never resolve
+                # this bridge future — a stubborn coroutine that swallows
+                # CancelledError keeps the THREAD alive, so thread death
+                # alone is not a sufficient wedge signal
+                if self._closed and (
+                    not self._thread.is_alive()
+                    or (self._dead_at is not None
+                        and _time.time() > self._dead_at)
+                ):
+                    if not self.loop.is_closed():
+                        # cancelling after close would fire the bridge
+                        # future's cross-loop callback into a closed
+                        # loop (logged noise, no effect)
+                        fut.cancel()
                     raise RuntimeError(
                         "actor event loop shut down during call"
                     ) from None
@@ -122,6 +151,11 @@ class ActorEventLoop:
         if self._closed:
             return
         self._closed = True
+        import time as _time
+
+        # past this point call() gives up on unresolved bridge futures
+        # even if the loop thread is still draining a stubborn coroutine
+        self._dead_at = _time.time() + join_timeout + self.DRAIN_TIMEOUT_S
 
         def _cancel_and_stop():
             for t in asyncio.all_tasks(self.loop):

@@ -93,6 +93,31 @@ _DEFS: Dict[str, tuple] = {
     # router membership refresh cadence (a BACKGROUND thread, so the
     # steady-state request path stays RPC-free; failures force a refresh)
     "serve_fastpath_refresh_s": (float, 1.0),
+    # router saturation bound: with every replica pair at >= this many
+    # locally-observed in-flight requests, submit fails FAST with
+    # ClusterOverloadedError instead of queueing behind the backlog;
+    # 0 = unbounded (no fail-fast)
+    "serve_fastpath_max_inflight": (int, 0),
+    # --- overload control plane (admission + backpressure; see README
+    # "Overload control") ---
+    # GCS admission controller: max in-system (queued + dep-waiting +
+    # running) normal tasks per driver; 0 disables admission control.
+    # Over the bound, submit_task returns a typed retryable rejection
+    # (ClusterOverloadedError client-side) — never a silent drop
+    "admission_max_pending_per_driver": (int, 0),
+    # pacing hint attached to admission rejections and overload pushes
+    "admission_retry_after_s": (float, 0.25),
+    # client-side pacing: retry rejected admissions (and slow submitters
+    # down while the GCS advertises overload) instead of failing fast
+    "admission_pacing_enabled": (bool, True),
+    # total budget a rejected task may spend re-attempting admission
+    # before its refs fail with ClusterOverloadedError
+    "admission_pacing_max_s": (float, 10.0),
+    # cluster overload state (hysteresis, derived each scheduler round
+    # from GCS queue depth + daemon-reported queue depths): overloaded
+    # when queued tasks exceed high*total_CPUs, cleared below low*CPUs
+    "overload_pending_high_per_cpu": (float, 8.0),
+    "overload_pending_low_per_cpu": (float, 2.0),
     "num_workers_soft_limit": (int, 0),  # 0 -> num_cpus
     "worker_start_timeout_s": (float, 30.0),
     "metrics_report_interval_ms": (float, 2000.0),

@@ -31,6 +31,26 @@ class ActorDiedError(ActorError):
     """The actor died before/while executing the call (reference: RayActorError)."""
 
 
+class ClusterOverloadedError(RayTpuError):
+    """The GCS admission controller refused the submission: this driver's
+    in-system task count is at its bound (reference shape: the pushback in
+    Ray's backpressure RFCs — reject loudly instead of queueing without
+    bound). RETRYABLE: ``retry_after_s`` carries the server's pacing hint;
+    with ``admission_pacing_enabled`` the client retries admission itself
+    for up to ``admission_pacing_max_s`` before surfacing this error."""
+
+    def __init__(self, message: str, retry_after_s: float = 0.0):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+class DeadlineExceededError(RayTpuError, TimeoutError):
+    """A request's deadline expired before its handler ran, so it was shed
+    (serve fast-path deadline-aware load shedding). A DELIVERED typed
+    outcome, never a silent drop: the submitter's response resolves with
+    this error exactly once."""
+
+
 class ObjectLostError(RayTpuError):
     """Object can no longer be retrieved and could not be reconstructed
     (reference: ObjectLostError / ObjectReconstructionFailedError)."""

@@ -112,6 +112,11 @@ class NodeResourceState:
     total: np.ndarray = None  # [N, R] float32
     available: np.ndarray = None  # [N, R] float32
     alive: np.ndarray = None  # [N] bool
+    # [N] bool: live daemons marked unschedulable (graceful drain). A
+    # draining row reads alive=False so every kernel/allocation path
+    # masks it out with zero new code, but release() still credits it —
+    # running tasks bleed off normally instead of leaking debits.
+    draining: np.ndarray = None
     labels: List[Dict[str, str]] = field(default_factory=list)
 
     def __post_init__(self):
@@ -122,6 +127,8 @@ class NodeResourceState:
             self.available = np.zeros((0, r), dtype=np.float32)
         if self.alive is None:
             self.alive = np.zeros((0,), dtype=bool)
+        if self.draining is None:
+            self.draining = np.zeros((0,), dtype=bool)
         self._index: Dict[str, int] = {nid: i for i, nid in enumerate(self.node_ids)}
         # Row indices whose availability changed since the last consume_dirty()
         # — the incremental-upload feed for device-resident scheduler views
@@ -186,6 +193,7 @@ class NodeResourceState:
         self.total = np.vstack([self.total, vec[None, :]])
         self.available = np.vstack([self.available, vec[None, :]])
         self.alive = np.append(self.alive, True)
+        self.draining = np.append(self.draining, False)
         idx = len(self.node_ids)
         self.node_ids.append(node_id)
         self.labels.append(dict(labels or {}))
@@ -201,6 +209,7 @@ class NodeResourceState:
         # availability so the kernels mask it out — same effect as the
         # reference erasing the node from the cluster view.
         self.alive[idx] = False
+        self.draining[idx] = False
         self.available[idx] = 0.0
         self.total[idx] = 0.0
         self.topology_version += 1
@@ -211,6 +220,28 @@ class NodeResourceState:
         vec = self.space.vector(resources)
         self.total[idx] = vec
         self.available[idx] = vec.copy()
+        self.alive[idx] = True
+        self.draining[idx] = False
+        self.topology_version += 1
+
+    def drain_node(self, node_id: str) -> None:
+        """Mark a LIVE node unschedulable (graceful drain): kernels and
+        allocate() see alive=False so nothing new lands, but the row's
+        capacity/debits are preserved and release() keeps crediting it —
+        running tasks bleed off instead of being killed."""
+        idx = self._index.get(node_id)
+        if idx is None or self.draining[idx]:
+            return
+        self.draining[idx] = True
+        self.alive[idx] = False
+        self.topology_version += 1
+
+    def undrain_node(self, node_id: str) -> None:
+        """Cancel a drain (demand returned before the terminate)."""
+        idx = self._index.get(node_id)
+        if idx is None or not self.draining[idx]:
+            return
+        self.draining[idx] = False
         self.alive[idx] = True
         self.topology_version += 1
 
@@ -239,7 +270,7 @@ class NodeResourceState:
         return True
 
     def release(self, node_idx: int, demand: np.ndarray) -> None:
-        if not self.alive[node_idx]:
+        if not self.alive[node_idx] and not self.draining[node_idx]:
             return
         old = self.available[node_idx].copy() if self._delta_enabled else None
         self.available[node_idx] = np.minimum(
@@ -283,6 +314,7 @@ class NodeResourceState:
             total=self.total.copy(),
             available=self.available.copy(),
             alive=self.alive.copy(),
+            draining=self.draining.copy(),
             labels=[dict(l) for l in self.labels],
         )
         return s

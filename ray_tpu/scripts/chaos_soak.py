@@ -48,6 +48,14 @@ ap.add_argument("--dag", action="store_true",
                      "pipeline (ChannelClosedError) and it is torn down "
                      "and recompiled — exercising the rpc_dag_* plane "
                      "under churn")
+ap.add_argument("--bursty", action="store_true",
+                help="mix seeded submission BURSTS into the workload and "
+                     "arm the overload control plane (tight per-driver "
+                     "admission bound + pacing + advisory throttle): "
+                     "bursts overrun the bound, rejections pace-and-"
+                     "retry, and every task still terminally resolves — "
+                     "typed ClusterOverloadedError outcomes are counted "
+                     "separately, never as errors")
 ap.add_argument("--serve", action="store_true",
                 help="mix serve fast-path deployments into the workload: "
                      "bursts of channel-plane requests against "
@@ -88,7 +96,23 @@ sched = chaos.install(chaos.FaultSchedule(seed=args.seed, rules=[
     chaos.drop(src="node-*", dst="gcs", p=0.001, hook="client_send"),
 ]))
 
-cluster = Cluster()
+_overrides = {}
+if args.bursty:
+    # arm the overload control plane so the burst mix exercises it: a
+    # small per-driver admission bound, fast pacing, and low throttle
+    # thresholds (the soak gate still requires 0 task errors — typed
+    # overload rejections are budgeted separately below)
+    _overrides = {
+        "admission_max_pending_per_driver": 48,
+        "admission_retry_after_s": 0.1,
+        "admission_pacing_enabled": True,
+        "admission_pacing_max_s": 60.0,
+        "overload_pending_high_per_cpu": 6.0,
+        "overload_pending_low_per_cpu": 2.0,
+    }
+from ray_tpu.core.config import Config as _Config
+
+cluster = Cluster(config=_Config(dict(_overrides)))
 # STABLE resource: the --serve mix pins the serve controller here so the
 # control plane survives churn-node kills (replicas still float and die)
 stable = cluster.add_node(num_cpus=2, node_id="stable",
@@ -118,7 +142,7 @@ def kill_one_churn_node():
 
 
 sched.register_kill("churn", kill_one_churn_node)
-ray_tpu.init(address=cluster.address)
+ray_tpu.init(address=cluster.address, config=dict(_overrides) or None)
 
 @ray_tpu.remote(max_retries=8)
 def work(i, payload):
@@ -173,7 +197,8 @@ if args.dag:
 t_end = time.time() + args.duration
 stats = {"tasks": 0, "actor_calls": 0, "pgs": 0, "kills": 0, "errors": 0,
          "expected_actor_errs": 0, "dag_iters": 0, "dag_rebuilds": 0,
-         "serve_ok": 0, "serve_errors": 0, "serve_lost": 0}
+         "serve_ok": 0, "serve_errors": 0, "serve_lost": 0,
+         "bursts": 0, "overload_rejects": 0}
 last_report = time.time()
 payload = np.arange(1000)
 pending = []
@@ -193,6 +218,14 @@ while time.time() < t_end:
             pg.ready(timeout=10)
             remove_placement_group(pg)
             stats["pgs"] += 1
+        elif args.bursty and r < 0.94:
+            # a seeded submission BURST past the admission bound: the
+            # control plane must pace-and-retry (or reject TYPED) — each
+            # ref still terminally resolves when drained below
+            for k in range(40):
+                pending.append(("task", work.remote(i * 100 + k, payload),
+                                i * 100 + k))
+            stats["bursts"] += 1
         elif args.serve and r >= 0.97:
             # a burst of fast-path requests (submit all, then collect):
             # overlapping requests are what reroute-on-death must cover
@@ -249,8 +282,14 @@ while time.time() < t_end:
                 else:
                     stats["actor_calls"] += 1
             except Exception as e:
+                from ray_tpu.core.exceptions import ClusterOverloadedError
+
                 if kind == "actor":
                     stats["expected_actor_errs"] += 1  # calls in flight at node death
+                elif isinstance(e, ClusterOverloadedError):
+                    # typed admission outcome (--bursty): a DELIVERED
+                    # rejection, the overload contract — never an error
+                    stats["overload_rejects"] += 1
                 else:
                     stats["errors"] += 1
                     print("TASK ERROR:", repr(e)[:200], flush=True)
@@ -267,9 +306,13 @@ for kind, ref, arg in pending:
     try:
         ray_tpu.get(ref, timeout=90)
         stats["tasks" if kind == "task" else "actor_calls"] += 1
-    except Exception:
+    except Exception as e:
+        from ray_tpu.core.exceptions import ClusterOverloadedError
+
         if kind == "actor":
             stats["expected_actor_errs"] += 1
+        elif isinstance(e, ClusterOverloadedError):
+            stats["overload_rejects"] += 1
         else:
             stats["errors"] += 1
 if dag_c is not None:

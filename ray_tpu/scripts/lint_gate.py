@@ -94,6 +94,14 @@ def main(argv=None) -> int:
                          "node kills with the SLO gate (zero lost / "
                          "duplicate / wrong responses) wired into the "
                          "exit code; artifact: serve_storm.json")
+    ap.add_argument("--overload-storm", action="store_true",
+                    help="also run the overload-control storm in SMOKE "
+                         "mode (scripts/overload_storm.py --smoke): "
+                         "bursty open-loop traffic past saturation with "
+                         "the control-plane A/B, gated on zero silent "
+                         "drops, the goodput ratio/fraction bars, and a "
+                         "clean strict-terminal invariant check "
+                         "(artifact: overload_storm.json)")
     ap.add_argument("--tier1", action="store_true",
                     help="also run the tier-1 suite with --durations=25 "
                          "and save the output as an artifact")
@@ -305,6 +313,24 @@ def main(argv=None) -> int:
             sys.stderr.write(proc.stderr[-2000:])
             return 1
         print(f"serve_storm: SLO green (artifact: {art})")
+
+    # (4d) overload-control storm smoke: the graceful-degradation gate
+    # (no silent drops, goodput holds vs the control-off collapse arm)
+    if args.overload_storm:
+        art = os.path.join(args.artifact_dir, "overload_storm.json")
+        proc = subprocess.run(
+            [sys.executable, "-m", "ray_tpu.scripts.overload_storm",
+             "--smoke", "--json", art],
+            cwd=REPO, capture_output=True, text=True,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        )
+        sys.stdout.write(proc.stdout)
+        if proc.returncode != 0:
+            print("lint_gate: overload storm gate RED (silent drop or "
+                  "goodput collapse)", file=sys.stderr)
+            sys.stderr.write(proc.stderr[-2000:])
+            return 1
+        print(f"overload_storm: gate green (artifact: {art})")
 
     # (5) tier-1 with per-test durations as a CI artifact. The pytest
     # process writes a final metrics snapshot at exit (util/metrics.py

@@ -98,6 +98,19 @@ _M_QUEUE_DEPTH = _metrics.Gauge(
     "serve fast-path pending+executing requests on one replica loop",
     tag_keys=("deployment",),
 )
+_M_SHED = _metrics.Counter(
+    "ray_tpu_serve_shed_total",
+    "requests shed by the replica drain loop because their deadline "
+    "expired before a handler ran (each resolves the submitter with "
+    "DeadlineExceededError exactly once)",
+    tag_keys=("deployment",),
+)
+_M_REJECTED = _metrics.Counter(
+    "ray_tpu_serve_rejected_total",
+    "requests failed fast by the router because every replica pair was "
+    "saturated (serve_fastpath_max_inflight)",
+    tag_keys=("deployment",),
+)
 _FLUSH_EVERY = 64
 
 #: live routers, for serve.shutdown() to sweep (weak: a dropped handle's
@@ -127,7 +140,11 @@ class _Waiter:
 
     def __init__(self, rid: str, req: tuple):
         self.rid = rid
-        self.req = req  # (rid, method, args, kwargs) — repacked per frame
+        # (rid, method, args, kwargs, deadline) — repacked per frame;
+        # deadline is absolute wall-clock (time.time()) or None, carried
+        # IN the coalesced frame so the replica drain loop can shed
+        # expired requests before a handler ever runs
+        self.req = req
         self.ev = threading.Event()
         self.value: Any = None
         self.is_err = False
@@ -206,6 +223,13 @@ class FastPathRouter:
         self._force_remote = force_remote
         self._cap = int(self._rt.config.serve_fastpath_channel_bytes)
         self._refresh_s = float(self._rt.config.serve_fastpath_refresh_s)
+        # saturation bound (overload control): with every live pair at
+        # >= this many in-flight requests, submit fails FAST with a
+        # typed ClusterOverloadedError instead of queueing behind the
+        # backlog; 0 = unbounded
+        self._max_inflight = int(
+            getattr(self._rt.config, "serve_fastpath_max_inflight", 0)
+        )
         self._lock = threading.Lock()
         # per-replica pair-build locks: one replica still STARTING must
         # not head-of-line block pair builds to healthy replicas (the
@@ -223,8 +247,11 @@ class FastPathRouter:
         # bump goes through _bump's lock
         self._stats_lock = threading.Lock()
         self.stats = {"submitted": 0, "completed": 0, "rerouted": 0,
-                      "duplicates": 0, "failed": 0}
+                      "duplicates": 0, "failed": 0, "rejected": 0,
+                      "shed": 0}
         self._m_key = _M_REQ_SECONDS.series_key(
+            {"deployment": deployment_name})
+        self._m_rej_key = _M_REJECTED.series_key(
             {"deployment": deployment_name})
         self._m_lat: List[float] = []
         _ROUTERS.add(self)
@@ -285,21 +312,34 @@ class FastPathRouter:
 
     # ------------------------------------------------------------ routing
 
-    def _pick(self, exclude: Set[str]) -> Optional[str]:
+    def _pick(self, exclude: Set[str]) -> Tuple[Optional[str], Optional[str]]:
         """Power-of-two-choices on locally observed per-pair in-flight
-        counts (reference: pow_2_scheduler.py), over live membership."""
+        counts (reference: pow_2_scheduler.py), over live membership.
+        Returns (actor_id, reason): reason is None on a pick, "empty"
+        when membership is empty/excluded, "saturated" when every live
+        pair is at the serve_fastpath_max_inflight bound (the caller
+        fails FAST with a typed error instead of queueing)."""
         with self._lock:
             ids = [a for a in self._actor_ids
                    if a not in exclude and a not in self._dead]
             if not ids:
-                return None
+                return None, "empty"
+            if self._max_inflight > 0:
+                open_ids = [
+                    a for a in ids
+                    if (self._pairs.get(a) is None
+                        or self._pairs[a].inflight < self._max_inflight)
+                ]
+                if not open_ids:
+                    return None, "saturated"
+                ids = open_ids
             if len(ids) == 1:
-                return ids[0]
+                return ids[0], None
             a, b = self._rng.sample(ids, 2)
             pa, pb = self._pairs.get(a), self._pairs.get(b)
             la = pa.inflight if pa is not None else 0
             lb = pb.inflight if pb is not None else 0
-            return a if la <= lb else b
+            return (a if la <= lb else b), None
 
     def _ensure_pair(self, actor_id: str) -> _Pair:
         """Get or build the channel pair for one replica. The build is the
@@ -385,32 +425,59 @@ class FastPathRouter:
 
     # ------------------------------------------------------------- submit
 
-    def submit(self, method: Optional[str], args, kwargs) -> FastPathResponse:
+    def submit(self, method: Optional[str], args, kwargs,
+               deadline_s: Optional[float] = None) -> FastPathResponse:
         if self._closed:
             raise RuntimeError("serve fast-path router is shut down")
         self._ensure_refresher()
         rid = new_id("req")
-        w = _Waiter(rid, (rid, method, args, kwargs))
+        # absolute wall-clock deadline rides the coalesced frame: the
+        # replica drain loop sheds requests already past it before a
+        # handler runs (same-host clocks; the relay fallback assumes
+        # synced clocks, like any cross-node deadline). `is not None`:
+        # a caller-computed remaining budget of 0.0 means ALREADY
+        # expired (shed on arrival), not "no deadline"
+        deadline = (
+            time.time() + deadline_s if deadline_s is not None else None
+        )
+        w = _Waiter(rid, (rid, method, args, kwargs, deadline))
         self._bump("submitted")
         self._submit_waiter(w, set())
         return FastPathResponse(w)
+
+    def _reject_saturated(self, w: _Waiter) -> None:
+        """Every live pair is at its in-flight bound: fail FAST with a
+        typed retryable error — queueing behind the backlog would just
+        convert overload into timeouts."""
+        from ray_tpu.core.exceptions import ClusterOverloadedError
+
+        self._bump("rejected")
+        if _metrics.ENABLED:
+            _M_REJECTED.inc_k(self._m_rej_key)
+        w.finish(ClusterOverloadedError(
+            f"every replica of {self.deployment_name} is saturated "
+            f"(>= {self._max_inflight} in flight per pair)"
+        ), is_err=True)
 
     def _submit_waiter(self, w: _Waiter, exclude: Set[str]) -> None:
         last_err: Optional[BaseException] = None
         for attempt in range(self.MAX_REROUTES + 3):
             if self._closed:
                 break
-            actor_id = self._pick(exclude)
-            if actor_id is None:
+            actor_id, why = self._pick(exclude)
+            if actor_id is None and why != "saturated":
                 # stale/empty membership (all replicas excluded or a
                 # rescale in flight): forced refresh is the failure-path
                 # RPC, never the steady-state one
                 self.refresh_now()
-                actor_id = self._pick(exclude)
-                if actor_id is None:
-                    time.sleep(min(0.1 * (attempt + 1), 0.5))
-                    exclude = set()
-                    continue
+                actor_id, why = self._pick(exclude)
+            if why == "saturated":
+                self._reject_saturated(w)
+                return
+            if actor_id is None:
+                time.sleep(min(0.1 * (attempt + 1), 0.5))
+                exclude = set()
+                continue
             try:
                 pair = self._ensure_pair(actor_id)
             except Exception as e:  # noqa: BLE001 - replica came down
@@ -519,6 +586,14 @@ class FastPathRouter:
             self._bump("duplicates")
             return
         w.finish(value, is_err)
+        if is_err:
+            from ray_tpu.core.exceptions import DeadlineExceededError
+
+            if isinstance(value, DeadlineExceededError):
+                # replica-side deadline shed, delivered as a typed
+                # outcome — tracked so exactly-once accounting over
+                # ok+shed+failed is assertable from the router alone
+                self._bump("shed")
         self._bump("completed")
         self._observe_latency(time.monotonic() - w.t0)
 
@@ -671,14 +746,16 @@ class FastPathRouter:
 
 
 class _Req:
-    __slots__ = ("rpair", "rid", "method", "args", "kwargs", "t")
+    __slots__ = ("rpair", "rid", "method", "args", "kwargs", "deadline",
+                 "t")
 
-    def __init__(self, rpair, rid, method, args, kwargs):
+    def __init__(self, rpair, rid, method, args, kwargs, deadline=None):
         self.rpair = rpair
         self.rid = rid
         self.method = method
         self.args = args
         self.kwargs = kwargs
+        self.deadline = deadline  # absolute time.time() or None
         self.t = time.monotonic()
 
 
@@ -715,6 +792,15 @@ class ReplicaFastPath:
         self._aio = aio
         self._sizer = AdaptiveBatchSizer(target_latency_s, batch_max)
         self._max_inflight = max(batch_max * 4, 8)
+        # execution-concurrency bound = the deployment's declared
+        # max_ongoing_requests (the replica's sync pool is sized by it):
+        # while this many items are dispatched-but-unfinished, new
+        # groups HOLD in _pending — which is where the deadline check
+        # lives, so at overload expired requests shed instead of
+        # stacking invisibly inside the executor's queue
+        self._max_exec = int(getattr(
+            getattr(instance, "_sync_pool", None), "_max_workers", 32
+        ) or 32)
         self._pairs: Dict[str, _RPair] = {}
         self._pairs_lock = threading.Lock()
         self._pending: "deque[_Req]" = deque()
@@ -726,7 +812,10 @@ class ReplicaFastPath:
         dep = str(ident[1]) if ident else "unknown"
         self._m_batch_key = _M_BATCH_SIZE.series_key({"deployment": dep})
         self._m_depth_key = _M_QUEUE_DEPTH.series_key({"deployment": dep})
+        self._m_shed_key = _M_SHED.series_key({"deployment": dep})
         self._m_batches: List[int] = []
+        # deadline sheds on this replica (single-writer: the loop thread)
+        self._shed = 0
 
     # ----------------------------------------------------------- lifecycle
 
@@ -809,17 +898,56 @@ class ReplicaFastPath:
                 reqs = serialization.loads(data)
             except Exception:  # noqa: BLE001 - alien frame: nothing to ack
                 continue
-            for rid, method, args, kwargs in reqs:
-                self._pending.append(_Req(rp, rid, method, args, kwargs))
+            for rid, method, args, kwargs, deadline in reqs:
+                self._pending.append(
+                    _Req(rp, rid, method, args, kwargs, deadline)
+                )
             progressed = True
         # exported for the autoscaling stats push (replica.py reads it on
         # its side thread; single-writer plain attribute)
         self._inst._fp_ongoing = self._inflight + len(self._pending)
         return progressed
 
+    def _shed_expired_front(self) -> int:
+        """Shed queued requests (FIFO front) whose deadline already
+        passed: each gets a typed DeadlineExceededError response instead
+        of a handler run. Runs even while the executor is saturated —
+        that IS the overload case shedding exists for."""
+        now = time.time()
+        n = 0
+        while self._pending:
+            it = self._pending[0]
+            if it.deadline is None or now <= it.deadline:
+                break
+            self._pending.popleft()
+            self._shed_one(it, now)
+            n += 1
+        return n
+
+    def _shed_one(self, it: _Req, now: float) -> None:
+        from ray_tpu.core.exceptions import DeadlineExceededError
+
+        self._shed += 1
+        if _metrics.ENABLED:
+            _M_SHED.inc_k(self._m_shed_key)
+        self._respond(it.rpair, it.rid, DeadlineExceededError(
+            f"request {it.rid[:12]} shed: deadline expired "
+            f"{now - it.deadline:.3f}s before a handler ran"
+        ), True)
+
     def _maybe_dispatch(self) -> bool:
         if not self._pending:
             return False
+        shed_front = self._shed_expired_front()
+        if not self._pending:
+            return bool(shed_front)
+        if self._inflight >= self._max_exec:
+            # the deployment's declared concurrency bound
+            # (max_ongoing_requests) is in use: HOLD new groups here —
+            # excess work waits where the deadline check can shed it,
+            # and the channel ack word pushes further queueing back
+            # into the callers
+            return bool(shed_front)
         target = self._sizer.target()
         # vLLM-shaped continuous batching: an IDLE executor dispatches
         # whatever is pending immediately (no artificial window — the
@@ -829,9 +957,20 @@ class ReplicaFastPath:
         if self._inflight and len(self._pending) < target:
             oldest_age = time.monotonic() - self._pending[0].t
             if oldest_age < self._sizer.wait_budget():
-                return False
-        group = [self._pending.popleft()
-                 for _ in range(min(target, len(self._pending)))]
+                return bool(shed_front)
+        # deadline check again at pop time (a group assembled from a
+        # deep queue can contain newly-expired items past the front)
+        want = min(target, len(self._pending))
+        now = time.time()
+        group: List[_Req] = []
+        while self._pending and len(group) < want:
+            it = self._pending.popleft()
+            if it.deadline is not None and now > it.deadline:
+                self._shed_one(it, now)
+            else:
+                group.append(it)
+        if not group:
+            return True  # only sheds this pass; retry next iteration
         with self._exec_lock:
             self._inflight += len(group)
         if _metrics.ENABLED:

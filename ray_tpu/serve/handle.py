@@ -57,6 +57,13 @@ class DeploymentResponse:
         return self._ref
 
 
+def _rebuild_handle(deployment_name, app_name, method_name,
+                    deadline_s=None):
+    h = DeploymentHandle(deployment_name, app_name, method_name)
+    h._deadline_s = deadline_s
+    return h
+
+
 class DeploymentHandle:
     # how often a hot handle re-checks the replica-set version with the
     # controller (reference: router long-polls; a per-request RPC would make
@@ -82,16 +89,31 @@ class DeploymentHandle:
         # handle.method.remote() reuses the parent's channel pairs.
         self._fast_path = False
         self._fp_router: List = [None]
+        # optional per-request deadline (seconds) stamped into every
+        # fast-path frame from this handle: expired requests are SHED by
+        # the replica drain loop with a typed DeadlineExceededError (the
+        # task-layer fallback ignores it — use result(timeout=) there)
+        self._deadline_s: Optional[float] = None
 
     # picklable: handles travel into other replicas for composition
+    # (deadline_s rides along — a composed inner handle keeps its SLO)
     def __reduce__(self):
-        return (DeploymentHandle,
-                (self.deployment_name, self.app_name, self._method_name))
+        return (_rebuild_handle,
+                (self.deployment_name, self.app_name, self._method_name,
+                 self._deadline_s))
 
-    def options(self, method_name: Optional[str] = None) -> "DeploymentHandle":
-        h = DeploymentHandle(self.deployment_name, self.app_name, method_name)
+    def options(self, method_name: Optional[str] = None,
+                deadline_s: Optional[float] = None) -> "DeploymentHandle":
+        """Unset fields INHERIT from this handle: options(deadline_s=...)
+        on a method-bound handle keeps its method, and vice versa."""
+        h = DeploymentHandle(
+            self.deployment_name, self.app_name,
+            method_name if method_name is not None else self._method_name,
+        )
         h._fast_path = self._fast_path
         h._fp_router = self._fp_router  # share the channel pairs
+        h._deadline_s = deadline_s if deadline_s is not None \
+            else self._deadline_s
         return h
 
     # --------------------------------------------------------------- routing
@@ -231,10 +253,12 @@ class DeploymentHandle:
         # membership upkeep lives on the router's refresher thread
         r = self._fp_router[0]
         if r is not None and self._use_fastpath():
-            return r.submit(self._method_name, args, kwargs)
+            return r.submit(self._method_name, args, kwargs,
+                            deadline_s=self._deadline_s)
         self._maybe_refresh()
         if self._use_fastpath():
-            return self._router().submit(self._method_name, args, kwargs)
+            return self._router().submit(self._method_name, args, kwargs,
+                                         deadline_s=self._deadline_s)
         ref, aid = self._submit(args, kwargs)
         dead: set = set()  # populated by resubmit as deaths occur
         last = [aid]

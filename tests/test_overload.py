@@ -1,0 +1,492 @@
+"""Overload control plane tests (ISSUE-13): GCS admission control,
+deadline-aware shedding on the serve fast path, drain-based graceful
+degradation, backpressure/throttle propagation, the autoscaler
+launch-retry/executor satellites, and the bounded async-actor drain.
+
+Every cluster test runs under ``invariant_sanitizer`` so the admission
+ledger's enter/exit pairing (and the rest of the protocol invariants) is
+replayed and checked, not just "didn't crash".
+"""
+
+import asyncio
+import os
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.config import Config
+from ray_tpu.core.exceptions import (
+    ClusterOverloadedError,
+    DeadlineExceededError,
+)
+from ray_tpu.cluster.cluster_utils import Cluster
+
+
+def _cluster(overrides, nodes=1, cpus=2):
+    cfg = dict(overrides)
+    cfg.setdefault("log_to_driver", False)
+    c = Cluster(config=Config(dict(cfg)))
+    for _ in range(nodes):
+        c.add_node(num_cpus=cpus)
+    c.wait_for_nodes(nodes)
+    return c, cfg
+
+
+# ------------------------------------------------------- admission control
+
+
+def test_admission_reject_is_typed_with_retry_after(invariant_sanitizer):
+    """Over the per-driver bound with pacing OFF: the excess surfaces as
+    ClusterOverloadedError (with the server's retry_after hint), the
+    admitted tasks complete, and EVERY ref terminally resolves."""
+    c, cfg = _cluster({
+        "admission_max_pending_per_driver": 4,
+        "admission_pacing_enabled": False,
+    })
+    ray_tpu.init(address=c.address, config=cfg)
+    try:
+        @ray_tpu.remote(num_cpus=1)
+        def slow(x):
+            time.sleep(0.4)
+            return x
+
+        refs = [slow.remote(i) for i in range(10)]
+        ok, rejected = 0, 0
+        for r in refs:
+            try:
+                ray_tpu.get(r, timeout=30)
+                ok += 1
+            except ClusterOverloadedError as e:
+                assert e.retry_after_s > 0
+                rejected += 1
+        assert ok + rejected == 10  # zero silent drops
+        assert ok >= 4 and rejected > 0
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
+
+
+def test_admission_pacing_retries_to_completion(invariant_sanitizer):
+    """With pacing ON, rejected submissions park and retry: a burst 3x
+    over the bound fully completes (backpressure, not failure)."""
+    c, cfg = _cluster({
+        "admission_max_pending_per_driver": 4,
+        "admission_pacing_enabled": True,
+        "admission_pacing_max_s": 30.0,
+        "admission_retry_after_s": 0.05,
+    })
+    ray_tpu.init(address=c.address, config=cfg)
+    try:
+        @ray_tpu.remote(num_cpus=1)
+        def slow(x):
+            time.sleep(0.15)
+            return x
+
+        assert ray_tpu.get(
+            [slow.remote(i) for i in range(12)], timeout=60
+        ) == list(range(12))
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
+
+
+def test_throttle_push_and_unthrottle_roundtrip(invariant_sanitizer):
+    """Backpressure propagation: deep queue -> the GCS derives overload
+    and pushes the advisory throttle to the driver; draining the queue
+    pushes the clear. (Admission off: this isolates the throttle.)"""
+    c, cfg = _cluster({
+        "overload_pending_high_per_cpu": 0.5,   # 2 CPUs -> high at 1
+        "overload_pending_low_per_cpu": 0.25,
+        "admission_pacing_enabled": False,      # no pacing: timing-free
+    })
+    ray_tpu.init(address=c.address, config=cfg)
+    try:
+        from ray_tpu.core import api as _api
+
+        rt = _api._runtime
+        assert rt.overload_state()["overloaded"] is False
+
+        @ray_tpu.remote(num_cpus=1)
+        def slow(x):
+            time.sleep(0.25)
+            return x
+
+        refs = [slow.remote(i) for i in range(16)]
+        deadline = time.time() + 10
+        while time.time() < deadline and \
+                not rt.overload_state()["overloaded"]:
+            time.sleep(0.02)
+        assert rt.overload_state()["overloaded"] is True
+        ray_tpu.get(refs, timeout=60)
+        deadline = time.time() + 10
+        while time.time() < deadline and rt.overload_state()["overloaded"]:
+            time.sleep(0.02)
+        assert rt.overload_state()["overloaded"] is False
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
+
+
+# ----------------------------------------------- serve fast path shedding
+
+
+def test_deadline_shed_exactly_once_accounting(invariant_sanitizer):
+    """Requests past their frame-carried deadline are shed by the replica
+    drain loop with a typed DeadlineExceededError; every response is
+    delivered exactly once (ok + shed == submitted, 0 duplicates), and
+    the shed counter reaches the cluster metrics plane."""
+    from ray_tpu import serve
+
+    c, cfg = _cluster({"metrics_report_interval_ms": 200.0}, cpus=4)
+    ray_tpu.init(address=c.address, config=cfg)
+    try:
+        @serve.deployment(num_replicas=1, fast_path=True,
+                          max_ongoing_requests=2, name="shed_model")
+        def shed_model(x):
+            time.sleep(0.25)
+            return x * 2
+
+        h = serve.run(shed_model.bind(), name="app", route_prefix=None)
+        assert h.remote(1).result(timeout=30) == 2
+        hd = h.options(deadline_s=0.4)
+        resps = [hd.remote(i) for i in range(8)]
+        ok, shed = 0, 0
+        for r in resps:
+            try:
+                r.result(timeout=30)
+                ok += 1
+            except DeadlineExceededError:
+                shed += 1
+        assert ok + shed == 8 and shed > 0 and ok > 0
+        st = h.fastpath_stats()
+        assert st["duplicates"] == 0
+        assert st["shed"] == shed
+        # the per-deployment shed counter rides worker->daemon->GCS
+        # metrics export onto the cluster plane
+        from ray_tpu.core import api as _api
+
+        rt = _api._runtime
+        deadline = time.time() + 15
+        seen = False
+        while time.time() < deadline and not seen:
+            m = rt.gcs.call("metrics", {"format": "json"}, timeout=10.0)
+            seen = "ray_tpu_serve_shed_total" in str(m)
+            if not seen:
+                time.sleep(0.25)
+        assert seen, "shed counter never reached the metrics plane"
+    finally:
+        from ray_tpu import serve as _s
+
+        _s.shutdown()
+        ray_tpu.shutdown()
+        c.shutdown()
+
+
+def test_router_fails_fast_when_all_pairs_saturated(invariant_sanitizer):
+    """With serve_fastpath_max_inflight bound and every pair full, submit
+    resolves immediately with ClusterOverloadedError instead of queueing
+    behind the backlog — and nothing is lost or duplicated."""
+    from ray_tpu import serve
+
+    c, cfg = _cluster({"serve_fastpath_max_inflight": 4}, cpus=4)
+    ray_tpu.init(address=c.address, config=cfg)
+    try:
+        @serve.deployment(num_replicas=1, fast_path=True,
+                          max_ongoing_requests=2, name="sat_model")
+        def sat_model(x):
+            time.sleep(0.3)
+            return x
+
+        h = serve.run(sat_model.bind(), name="app", route_prefix=None)
+        assert h.remote(0).result(timeout=30) == 0
+        resps = [h.remote(i) for i in range(12)]
+        ok, rejected = 0, 0
+        for r in resps:
+            try:
+                r.result(timeout=30)
+                ok += 1
+            except ClusterOverloadedError:
+                rejected += 1
+        assert ok + rejected == 12 and rejected > 0 and ok >= 4
+        st = h.fastpath_stats()
+        assert st["duplicates"] == 0
+        assert st["rejected"] == rejected
+    finally:
+        from ray_tpu import serve as _s
+
+        _s.shutdown()
+        ray_tpu.shutdown()
+        c.shutdown()
+
+
+def test_handle_options_deadline_preserves_method_and_pickles():
+    """options(deadline_s=) on a method-bound handle keeps the method;
+    pickling carries the deadline (composition handles keep their SLO)."""
+    import pickle
+
+    from ray_tpu.serve.handle import DeploymentHandle
+
+    h = DeploymentHandle("dep", "app")
+    hm = h.options(method_name="predict")
+    hd = hm.options(deadline_s=0.4)
+    assert hd._method_name == "predict"
+    assert hd._deadline_s == 0.4
+    h2 = pickle.loads(pickle.dumps(hd))
+    assert h2._method_name == "predict" and h2._deadline_s == 0.4
+    # deadline_s=0.0 means "already expired", distinct from unset
+    assert h.options(deadline_s=0.0)._deadline_s == 0.0
+
+
+# ------------------------------------------------------ drain-based drain
+
+
+def test_drain_node_bleeds_inflight_and_excludes_new(invariant_sanitizer):
+    """drain_node racing in-flight dispatches: tasks already running on
+    the draining node COMPLETE (bleed, not kill), new tasks land only on
+    the other node, and the drained node ends with running == 0."""
+    c, cfg = _cluster({}, nodes=2, cpus=2)
+    node_a = c.daemons[0].node_id
+    node_b = c.daemons[1].node_id
+    ray_tpu.init(address=c.address, config=cfg)
+    try:
+        @ray_tpu.remote(num_cpus=1)
+        def where(t=0.0):
+            time.sleep(t)
+            return os.environ["RAY_TPU_NODE_ID"]
+
+        slow = [where.remote(0.8) for _ in range(4)]
+        time.sleep(0.3)  # let them dispatch onto both nodes
+        from ray_tpu.core import api as _api
+
+        rt = _api._runtime
+        rep = rt.gcs.call("drain_node", {"node_id": node_a}, timeout=5.0)
+        assert rep["ok"] and rep["draining"]
+        homes = ray_tpu.get(slow, timeout=60)
+        assert node_a in homes  # some ran there and still completed
+        after = ray_tpu.get([where.remote() for _ in range(8)], timeout=60)
+        assert set(after) == {node_b}
+        rep = rt.gcs.call("drain_node", {"node_id": node_a}, timeout=5.0)
+        assert rep["running"] == 0  # fully bled
+        # undrain: the node takes work again
+        rep = rt.gcs.call("drain_node",
+                          {"node_id": node_a, "undrain": True}, timeout=5.0)
+        assert rep["ok"] and not rep["draining"]
+        back = ray_tpu.get([where.remote(0.05) for _ in range(8)],
+                           timeout=60)
+        assert node_a in set(back)
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
+
+
+# -------------------------------------------------- autoscaler satellites
+
+
+class _AlwaysFailingProvider:
+    def __init__(self):
+        self.calls = 0
+        self._lock = threading.Lock()
+
+    def create_node(self, node_type, resources):
+        with self._lock:
+            self.calls += 1
+        raise RuntimeError("cloud permanently down")
+
+    def terminate_node(self, node_id):
+        pass
+
+    def non_terminated_nodes(self):
+        return []
+
+
+class _BlockingProvider(_AlwaysFailingProvider):
+    def __init__(self):
+        super().__init__()
+        self.release = threading.Event()
+
+    def create_node(self, node_type, resources):
+        with self._lock:
+            self.calls += 1
+        self.release.wait(timeout=30)
+        raise RuntimeError("cloud call finally failed")
+
+
+def _drive(scaler, ticks, sleep=0.05):
+    for _ in range(ticks):
+        scaler.update()
+        time.sleep(sleep)
+
+
+def test_launch_retry_budget_carries_and_exhausts():
+    """A persistently failing provider gets exactly 1 + launch_retries
+    attempts: the budget carries to each requeued replacement record and
+    requeueing stops at zero — tables stay bounded."""
+    from ray_tpu.autoscaler import NodeTypeConfig
+    from ray_tpu.autoscaler.instance_manager import (
+        AutoscalerV2,
+        InstanceStatus,
+    )
+
+    c, _cfg = _cluster({}, nodes=0)
+    try:
+        provider = _AlwaysFailingProvider()
+        scaler = AutoscalerV2(
+            (c.host, c.gcs.port), provider,
+            [NodeTypeConfig("cpu2", {"CPU": 2}, min_workers=1,
+                            max_workers=4)],
+            launch_retries=2, update_interval_s=0.05,
+        )
+        # min_workers seeds one QUEUED instance; drive ticks by hand
+        for nt in scaler.node_types.values():
+            for _ in range(nt.min_workers):
+                scaler.im.create_instance(nt.name, nt.resources)
+        _drive(scaler, 40)
+        insts = scaler.im.instances()
+        assert provider.calls == 3  # 1 original + 2 retries, then STOP
+        assert len(insts) == 3
+        assert all(i.status == InstanceStatus.ALLOCATION_FAILED
+                   for i in insts)
+        assert "retries exhausted" in insts[-1].history[-1][3] or any(
+            "retries exhausted" in i.history[-1][3] for i in insts
+        )
+        before = provider.calls
+        _drive(scaler, 10)
+        assert provider.calls == before  # no further retries, ever
+        scaler.shutdown()
+    finally:
+        c.shutdown()
+
+
+def test_blocking_provider_does_not_stall_reconciler():
+    """provider.create_node hangs: the reconciler tick keeps returning
+    promptly (launches run on the executor), the instance stays
+    REQUESTED (counted as in-flight — no duplicate launch), and the
+    failure reconciles once the call finally returns."""
+    from ray_tpu.autoscaler import NodeTypeConfig
+    from ray_tpu.autoscaler.instance_manager import (
+        AutoscalerV2,
+        InstanceStatus,
+    )
+
+    c, _cfg = _cluster({}, nodes=0)
+    try:
+        provider = _BlockingProvider()
+        scaler = AutoscalerV2(
+            (c.host, c.gcs.port), provider,
+            [NodeTypeConfig("cpu2", {"CPU": 2}, min_workers=1,
+                            max_workers=4)],
+            launch_retries=0, update_interval_s=0.05,
+        )
+        for nt in scaler.node_types.values():
+            for _ in range(nt.min_workers):
+                scaler.im.create_instance(nt.name, nt.resources)
+        t0 = time.time()
+        _drive(scaler, 8, sleep=0.01)
+        assert time.time() - t0 < 5.0  # ticks never blocked on the cloud
+        assert provider.calls == 1  # REQUESTED models the in-flight call
+        reqs = scaler.im.instances({InstanceStatus.REQUESTED})
+        assert len(reqs) == 1
+        provider.release.set()
+        deadline = time.time() + 10
+        while time.time() < deadline and scaler.im.instances(
+            {InstanceStatus.REQUESTED}
+        ):
+            _drive(scaler, 1, sleep=0.02)
+        assert scaler.im.instances({InstanceStatus.ALLOCATION_FAILED})
+        scaler.shutdown()
+    finally:
+        c.shutdown()
+
+
+# --------------------------------------------- async-actor drain satellite
+
+
+def test_async_actor_shutdown_drain_is_bounded():
+    """A coroutine that swallows CancelledError cannot wedge shutdown or
+    the dispatch threads: the drain is time-bounded and call() treats
+    (closed + grace expired) as actor death."""
+    from ray_tpu.core.async_actor import ActorEventLoop
+
+    aio = ActorEventLoop("test-drain")
+    aio.DRAIN_TIMEOUT_S = 1.0
+
+    async def stubborn():
+        while True:
+            try:
+                await asyncio.sleep(60)
+            except asyncio.CancelledError:
+                pass  # refuses to die
+
+    outcome = {}
+
+    def blocked_call():
+        try:
+            aio.call(stubborn, (), {})
+            outcome["err"] = None
+        except RuntimeError as e:
+            outcome["err"] = str(e)
+
+    t = threading.Thread(target=blocked_call, daemon=True)
+    t.start()
+    time.sleep(0.3)
+    t0 = time.time()
+    aio.shutdown(join_timeout=0.5)
+    assert time.time() - t0 < 4.0  # bounded despite the stubborn task
+    t.join(timeout=6.0)
+    assert not t.is_alive(), "dispatch thread wedged in call()"
+    assert "shut down" in (outcome["err"] or "")
+
+
+# ------------------------------------------- invariant checker unit tests
+
+
+def _apply_events(events):
+    from ray_tpu.analysis.invariants import InvariantChecker
+
+    evs = [dict(t="apply", c=i + 1, **e) for i, e in enumerate(events)]
+    return InvariantChecker(), evs
+
+
+def test_checker_admission_balanced_clean():
+    chk, evs = _apply_events([
+        {"k": "admit", "task": "t1", "owner": "d1"},
+        {"k": "admit_exit", "task": "t1", "owner": "d1"},
+    ])
+    assert chk.run(evs, strict_terminal=True) == []
+
+
+def test_checker_flags_exit_without_admit():
+    chk, evs = _apply_events([
+        {"k": "admit_exit", "task": "t1", "owner": "d1"},
+    ])
+    vs = chk.run(evs)
+    assert any(v.kind == "admission" for v in vs)
+
+
+def test_checker_flags_unresolved_admit_in_strict_terminal():
+    chk, evs = _apply_events([
+        {"k": "admit", "task": "t1", "owner": "d1"},
+    ])
+    assert chk.run(evs, strict_terminal=False) == []
+    chk2, evs2 = _apply_events([
+        {"k": "admit", "task": "t1", "owner": "d1"},
+    ])
+    vs = chk2.run(evs2, strict_terminal=True)
+    assert any(
+        v.kind == "admission" and "never terminally" in v.message
+        for v in vs
+    )
+
+
+def test_checker_duplicate_submission_converges():
+    """enter, enter (dup replay), exit (intake dedupe), exit (terminal):
+    the per-task counter converges to zero with no violation."""
+    chk, evs = _apply_events([
+        {"k": "admit", "task": "t1", "owner": "d1"},
+        {"k": "admit", "task": "t1", "owner": "d1"},
+        {"k": "admit_exit", "task": "t1", "owner": "d1"},
+        {"k": "admit_exit", "task": "t1", "owner": "d1"},
+    ])
+    assert chk.run(evs, strict_terminal=True) == []
