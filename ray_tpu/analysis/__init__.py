@@ -13,12 +13,24 @@ per-line ``# ray-lint: disable=<check>`` pragmas and a committed
 ratchet baseline. ``--dump-protocol`` emits the protocol model
 (including the state machines) as JSON.
 
-Runtime half: :class:`ray_tpu.analysis.sanitizer.LockOrderSanitizer`
-(instrumented-lock shim cross-checking the static lock graph via the
-``lock_sanitizer`` fixture) and :mod:`ray_tpu.analysis.invariants`
-(Lamport-clocked protocol tracer + offline happens-before invariant
-checker, ``invariant_sanitizer`` fixture / ``--check-trace``) — each
-runtime sanitizer is the dynamic cross-check of its static model.
+Runtime half: :mod:`ray_tpu.analysis.sanitizer` is the shared lock
+instrumentation seam (refcounted ``Lock``/``RLock``/``Condition``
+factory patches, one per-thread held stack, listener callbacks) with
+:class:`~ray_tpu.analysis.sanitizer.LockOrderSanitizer` riding it
+(cross-checking the static lock graph via the ``lock_sanitizer``
+fixture); :mod:`ray_tpu.analysis.invariants` (Lamport-clocked protocol
+tracer + offline happens-before invariant checker,
+``invariant_sanitizer`` fixture / ``--check-trace``); and
+:mod:`ray_tpu.analysis.racer` — the hybrid data-race sanitizer: the
+``cross-thread-field-write`` model emitted as a machine-readable
+watchlist (``--dump-watchlist``) and *validated* by a FastTrack-style
+vector-clock engine over the live control-plane threads
+(``race_sanitizer`` fixture / ``--race`` / ``chaos_soak --race``;
+seeded regression teeth in ``node_daemon.SEEDED_BUGS`` +
+``serve.fastpath.SEEDED_BUGS``) — each runtime sanitizer is the
+dynamic cross-check of its static model, and the racer reports a race
+on a statically-credited-locked field as a finding against the static
+analysis itself.
 
 Model-checking half: :mod:`ray_tpu.analysis.explore` runs the real GCS
 handler object under a virtual runtime and *searches* handler

@@ -58,7 +58,30 @@ def main(argv=None) -> int:
                         "explore writer/reader/poker interleavings of "
                         "one channel scenario (or 'all'), kill-at-any-op "
                         "included; exit 1 on any violation")
-    p.add_argument("--list-scenarios", action="store_true")
+    p.add_argument("--race", default=None, metavar="PROBE",
+                   nargs="?", const="all",
+                   help="instead of linting, run the happens-before "
+                        "race sanitizer's probe(s) (analysis/racer.py): "
+                        "one probe (or 'all') drives real control-plane "
+                        "code paths on controlled threads under the "
+                        "vector-clock engine; exit 1 on any detected "
+                        "race (--seed-bug re-introduces a known bug the "
+                        "probe must then catch)")
+    p.add_argument("--rounds", type=int, default=3,
+                   help="quiescence rounds per race probe (default 3)")
+    p.add_argument("--dump-watchlist", action="store_true",
+                   help="instead of linting, emit the race sanitizer's "
+                        "STAGE-1 static watchlist as JSON: every "
+                        "container/scalar field reachable from >= 2 "
+                        "execution contexts in cluster//serve//dag/, "
+                        "with the lock attrs the static pass credits "
+                        "(validated dynamically by --race)")
+    p.add_argument("--list-scenarios", action="store_true",
+                   help="list every model-checking/sanitizer scenario, "
+                        "kind-prefixed: control-plane interleaving "
+                        "scenarios (--explore NAME), 'memmodel:NAME' "
+                        "channel scenarios (--memmodel NAME), and "
+                        "'racer:NAME' race probes (--race NAME)")
     p.add_argument("--budget", type=int, default=500,
                    help="DFS schedule budget per scenario (default 500)")
     p.add_argument("--samples", type=int, default=200,
@@ -75,12 +98,20 @@ def main(argv=None) -> int:
                    metavar="NAME",
                    help="re-introduce a known fixed bug (gcs.SEEDED_BUGS "
                         "for --explore, channel.SEEDED_BUGS for "
-                        "--memmodel) — the regression harness")
+                        "--memmodel, node_daemon/fastpath SEEDED_BUGS "
+                        "for --race) — the regression harness")
     p.add_argument("--save-replay", default=None, metavar="FILE",
                    help="write the first (shrunk) counterexample here")
     p.add_argument("--replay", default=None, metavar="FILE",
                    help="re-execute a recorded counterexample "
-                        "deterministically; exit 1 if it still violates")
+                        "deterministically; exit 1 if it still "
+                        "violates. Kind-dispatched on the file's "
+                        "'kind' field: 'memmodel' replays through the "
+                        "channel model (analysis/memmodel.py), "
+                        "anything else through the control-plane "
+                        "explorer (analysis/explore.py); race-sanitizer "
+                        "artifacts (kind 'race-report') are reports, "
+                        "not replays, and are rejected with exit 2")
     args = p.parse_args(argv)
 
     # Import for side effect: populate the registry before --list-checks.
@@ -94,12 +125,16 @@ def main(argv=None) -> int:
     if args.list_scenarios:
         from ray_tpu.analysis.explore import SCENARIOS
         from ray_tpu.analysis.memmodel import CHANNEL_SCENARIOS
+        from ray_tpu.analysis.racer import RACE_PROBES
 
         for name in sorted(SCENARIOS):
             print(f"{name}: {SCENARIOS[name].description}")
         for name in sorted(CHANNEL_SCENARIOS):
             print(f"memmodel:{name}: "
                   f"{CHANNEL_SCENARIOS[name].description}")
+        for name in sorted(RACE_PROBES):
+            doc = (RACE_PROBES[name].__doc__ or "").split("\n")[0].strip()
+            print(f"racer:{name}: {doc}")
         return 0
 
     if args.replay is not None:
@@ -116,6 +151,11 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             return 2
         kind = rec.get("kind")
+        if kind == "race-report":
+            print("error: race-sanitizer artifacts are reports, not "
+                  "replays (the racer re-detects from the live probes: "
+                  "--race)", file=sys.stderr)
+            return 2
         try:
             if kind == "memmodel":
                 from ray_tpu.analysis import memmodel as _memmodel
@@ -135,6 +175,69 @@ def main(argv=None) -> int:
             print(v.format())
         print(f"{len(res.violations)} violation(s)")
         return 1 if res.violations else 0
+
+    if args.dump_watchlist:
+        from ray_tpu.analysis.racer import extract_watchlist
+
+        paths = None
+        if args.paths and args.paths != ["ray_tpu"]:
+            missing = [p_ for p_ in args.paths if not os.path.exists(p_)]
+            if missing:
+                print(f"error: no such path(s): {missing}",
+                      file=sys.stderr)
+                return 2
+            paths = args.paths
+        try:
+            wl = extract_watchlist(paths=paths)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        print(json.dumps(wl, indent=2))
+        return 0
+
+    if args.race is not None:
+        from ray_tpu.analysis import racer as _racer
+
+        requested = args.race.split("racer:", 1)[-1]
+        names = (
+            sorted(_racer.RACE_PROBES) if requested == "all"
+            else [requested]
+        )
+        unknown = [n for n in names if n not in _racer.RACE_PROBES]
+        if unknown:
+            print(f"error: unknown race probe(s) {unknown}; have "
+                  f"{sorted(_racer.RACE_PROBES)}", file=sys.stderr)
+            return 2
+        failed = False
+        wl = _racer.extract_watchlist()
+        for name in names:
+            try:
+                res = _racer.run_probe(
+                    name, seeded_bugs=args.seed_bug, rounds=args.rounds,
+                    watchlist=wl,
+                )
+            except ValueError as e:  # unknown --seed-bug name
+                print(f"error: {e}", file=sys.stderr)
+                return 2
+            print(res.summary())
+            if res.unresolved:
+                failed = True
+                for e, err in res.unresolved:
+                    print(f"  unresolved watchlist entry "
+                          f"{e['cls']}.{e['field']}: {err}",
+                          file=sys.stderr)
+            if res.detected:
+                failed = True
+                for r in res.races:
+                    print(f"  RACE {r['kind']} on {r['field']} "
+                          f"(static locked={r['static']['locked']})")
+                    for side in ("prior", "current"):
+                        a = r[side]
+                        print(f"    {side}: {a.get('thread')} "
+                              f"locks={a.get('locks')}")
+                        for fr in a.get("stack", ())[:3]:
+                            print(f"      {fr}")
+        return 1 if failed else 0
 
     if args.memmodel is not None:
         from ray_tpu.analysis import memmodel as _memmodel

@@ -1332,6 +1332,16 @@ _CONTAINER_CTORS = frozenset({
 
 @register
 class CrossThreadFieldWriteChecker(Checker):
+    """The static half of the hybrid race sanitizer. As a CHECKER it
+    reports only high-confidence unlocked findings in the daemon/GCS;
+    its extraction machinery (`_mutable_fields`/`_context_roots`/
+    `_calls_of`/`_mutations` + lock propagation) is also reused by
+    :func:`ray_tpu.analysis.racer.extract_watchlist` to emit the FULL
+    claim surface over cluster//serve//dag/ — every >= 2-context field
+    including the lock-protected ones with their credited lock attr —
+    which the dynamic vector-clock stage then validates at runtime
+    (``--dump-watchlist`` / ``--race``)."""
+
     name = "cross-thread-field-write"
     description = (
         "a GCS/daemon mutable container field is written from two "

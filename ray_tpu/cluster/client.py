@@ -16,6 +16,7 @@ accepted for symmetry (_parse_address strips the scheme).
 
 from __future__ import annotations
 
+import queue as queue_mod
 import threading
 import time
 from collections import OrderedDict, defaultdict, deque
@@ -189,7 +190,14 @@ class ClusterClient:
         self._task_out_ids: Dict[str, list] = {}  # task_id -> all output oids
         self._task_dep_ids: Dict[str, list] = {}  # task_id -> dep oids
         self._lineage_consumers: Dict[str, set] = {}  # dep oid -> consumer tids
-        self._gc_queue: deque = deque()
+        # SimpleQueue, not deque: producers include ObjectRef.__del__
+        # (which may fire inside a cyclic-GC pass while THIS thread holds
+        # self._lock, so the producer side must never lock) — SimpleQueue
+        # .put is the documented reentrant-safe primitive for exactly
+        # that context, and it gives the gc drain thread a real
+        # happens-before edge instead of relying on GIL-atomic deque ops
+        # (flagged by the race sanitizer, analysis/racer.py)
+        self._gc_queue: "queue_mod.SimpleQueue" = queue_mod.SimpleQueue()
         self._gcs_host, self._gcs_port = host, port
         self._closed = False
         self._nodes: Dict[str, dict] = {}
@@ -302,21 +310,25 @@ class ClusterClient:
 
     def _on_ref_del(self, oid: str) -> None:
         # Runs from __del__, possibly inside a cyclic-GC pass triggered
-        # while THIS thread already holds self._lock — so it must stay
-        # lock-free: deque.append is atomic; the GC thread applies the
-        # decrement under the lock.
+        # while THIS thread already holds self._lock — so it must never
+        # take it: SimpleQueue.put is reentrant-safe for destructor
+        # context; the GC thread applies the decrement under the lock.
         if not self._closed:
-            self._gc_queue.append(("decref", oid))
+            self._gc_queue.put(("decref", oid))
 
     def _queue_free(self, oid: str) -> None:
-        self._gc_queue.append(("check", oid))
+        self._gc_queue.put(("check", oid))
 
     def _release_task_deps(self, task_id: str) -> None:
         """Terminal task result: release its arg + output pins (idempotent —
         the pin list is popped exactly once). Actor calls additionally shed
         their lineage-consumer edges here: they are never reconstructed, so
         they must not pin their dep producers' specs past completion."""
-        pins = self._task_pins.pop(task_id, None)
+        # pop under _lock: the gc thread's _maybe_drop_lineage pops this
+        # table under the lock too (race sanitizer finding — the reader
+        # thread popped bare)
+        with self._lock:
+            pins = self._task_pins.pop(task_id, None)
         for oid in pins or ():
             self._unpin(oid)
         if pins is not None:
@@ -381,8 +393,11 @@ class ClusterClient:
                 except Exception:  # noqa: BLE001 - reconnect plane owns it
                     pass
             batch = []
-            while self._gc_queue:
-                batch.append(self._gc_queue.popleft())
+            while True:
+                try:
+                    batch.append(self._gc_queue.get_nowait())
+                except queue_mod.Empty:
+                    break
             if not batch:
                 continue
             # failed submissions drain here too (single thread, bounded):
@@ -556,7 +571,7 @@ class ClusterClient:
             f"{retry_after}s",
             retry_after_s=retry_after,
         )
-        self._gc_queue.append(("fail_submit", (meta, err)))
+        self._gc_queue.put(("fail_submit", (meta, err)))
 
     def _submit_blocking(self, gcs, meta: dict, timeout: float) -> dict:
         """Blocking submit_task that HONORS admission rejections: the
@@ -682,8 +697,8 @@ class ClusterClient:
             # failure-drain thread (this callback fires on the gcs READER
             # thread where blocking RPCs are forbidden, and one thread per
             # failure would be a thread storm on bulk fan-out failures)
-            self._gc_queue.append(("fail_submit", (meta,
-                                                   f"submission failed: {exc}")))
+            self._gc_queue.put(("fail_submit", (meta,
+                                                f"submission failed: {exc}")))
 
         self.gcs.call_async("submit_task", meta).add_done_callback(_cb)
 
@@ -765,19 +780,24 @@ class ClusterClient:
         # own_inflight vouchers are NOT stamped here: _refresh_inflight_deps
         # is the single source, run at every GCS submission (actor-call
         # metas never hit the gate, so they don't need vouchers at all)
-        for a in list(spec.args) + list(spec.kwargs.values()):
-            if isinstance(a, ObjectRef):
+        # _ref_index is mutated by the gc thread under _lock; reads take
+        # it too (race sanitizer finding — a torn read here would stamp
+        # a wrong producing task into the dep's lineage record)
+        with self._lock:
+            for a in list(spec.args) + list(spec.kwargs.values()):
+                if isinstance(a, ObjectRef):
+                    deps.append({
+                        "id": a.id,
+                        # producing task, for owner-side lineage
+                        # reconstruction
+                        "task": a.task_id or self._ref_index.get(a.id),
+                    })
+            for ref in nested.values():
                 deps.append({
-                    "id": a.id,
-                    # producing task, for owner-side lineage reconstruction
-                    "task": a.task_id or self._ref_index.get(a.id),
+                    "id": ref.id,
+                    "task": ref.task_id or self._ref_index.get(ref.id),
+                    "nested": True,
                 })
-        for ref in nested.values():
-            deps.append({
-                "id": ref.id,
-                "task": ref.task_id or self._ref_index.get(ref.id),
-                "nested": True,
-            })
         return {
             "task_id": spec.task_id,
             "name": spec.name,
@@ -1458,8 +1478,8 @@ class ClusterClient:
                     return rec["v"]
             if not loc.get("nodes") and allow_reconstruct and not attempted_reconstruct:
                 attempted_reconstruct = True
-                task_id = ref.task_id or self._ref_index.get(ref.id)
                 with self._lock:
+                    task_id = ref.task_id or self._ref_index.get(ref.id)
                     meta = self._task_meta.get(task_id) if task_id else None
                 if meta is not None:
                     # result will arrive via the normal task_result push
@@ -1472,7 +1492,8 @@ class ClusterClient:
     # ------------------------------------------------------------- data api
 
     def _get_one(self, ref: ObjectRef, deadline: Optional[float]) -> Any:
-        owned = ref.id in self._ref_index or ref.owner == self.worker_id
+        with self._lock:
+            owned = ref.id in self._ref_index or ref.owner == self.worker_id
         while True:
             e = self.store.try_get(ref)
             if e is not None:
@@ -1508,10 +1529,11 @@ class ClusterClient:
         (condition-variable wait, no polling); only refs owned elsewhere
         consult the GCS directory, at a coarse interval."""
         deadline = time.time() + timeout if timeout is not None else None
-        foreign = [
-            r for r in refs
-            if r.id not in self._ref_index and r.owner != self.worker_id
-        ]
+        with self._lock:
+            foreign = [
+                r for r in refs
+                if r.id not in self._ref_index and r.owner != self.worker_id
+            ]
         foreign_ready: set = set()
         last_dir_poll = 0.0
         while True:

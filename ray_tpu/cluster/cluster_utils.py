@@ -90,7 +90,9 @@ class Cluster:
         """Hard kill for fault-injection tests (reference: test_utils node
         killer used by test_chaos.py): drop the GCS connection and all
         workers without cleanup."""
-        for w in list(daemon.workers.values()):
+        with daemon._lock:
+            workers = list(daemon.workers.values())
+        for w in workers:
             if w.proc is not None:
                 try:
                     w.proc.kill()

@@ -36,6 +36,17 @@ from ray_tpu.cluster.rpc import (
 )
 from ray_tpu.util import metrics as _metrics
 
+#: Test-only regression switch (mirror of ``gcs.SEEDED_BUGS`` /
+#: ``channel.SEEDED_BUGS``): known, FIXED concurrency bugs the race
+#: sanitizer (analysis/racer.py) re-introduces to prove it still catches
+#: them. Production code never populates this. Names:
+#:
+#: - ``"metrics-push-unlocked"``: re-introduces one of PR 6's 21
+#:   node_daemon lock fixes — ``rpc_metrics_push`` appends to
+#:   ``_worker_metrics`` WITHOUT ``_lock``, racing the heartbeat
+#:   thread's drain (the exact rpc-loop/heartbeat pair the fix covered).
+SEEDED_BUGS: set = set()
+
 # --- observability (ray_tpu.obs): daemon-side metrics, module-scope.
 # Handler self-time carries an explicit ``node`` tag so the cluster
 # aggregate keeps per-node attribution even in the embedded test topology
@@ -797,6 +808,12 @@ class NodeDaemon:
         """Worker -> daemon (notify): a worker process's metric registry
         delta; queued here and folded into the node's next heartbeat
         export (workers have no GCS connection of their own)."""
+        if "metrics-push-unlocked" in SEEDED_BUGS:
+            # SEEDED BUG (test-only; see SEEDED_BUGS above): the append
+            # lands outside _lock, racing the heartbeat thread's drain —
+            # the re-introduced PR 6 fix the race sanitizer must catch.
+            self._worker_metrics.append(p["delta"])  # ray-lint: disable=cross-thread-field-write
+            return
         with self._lock:
             self._worker_metrics.append(p["delta"])
 
@@ -1727,18 +1744,25 @@ class NodeDaemon:
         beats = 0
         while not self._stopped:
             payload = {"node_id": self.node_id}
+            # one locked snapshot per beat feeds the load signal, the
+            # gauges below, and _sample_stats — the racer
+            # (analysis/racer.py) flagged the previous lock-free len()
+            # reads racing the rpc loop's locked mutations of
+            # _task_queue/_idle/workers
+            with self._lock:
+                n_queued = len(self._task_queue)
+                n_idle = len(self._idle)
+                n_workers = len(self.workers)
             if beats % 5 == 0:  # physical stats every ~5th beat (psutil
-                payload["stats"] = self._sample_stats()  # calls are cheap
-            beats += 1                                   # but not free)
+                payload["stats"] = self._sample_stats(n_workers)  # calls are
+            beats += 1                                  # cheap but not free
             # backpressure signal (overload control plane): task-queue
             # depth + worker saturation fold into the GCS's cluster
-            # overload derivation every beat (plain len() reads — the
-            # heartbeat thread already samples these fields lock-free
-            # for the gauges below)
+            # overload derivation every beat
             payload["load"] = {
-                "queued": len(self._task_queue),
-                "idle": len(self._idle),
-                "workers": len(self.workers),
+                "queued": n_queued,
+                "idle": n_idle,
+                "workers": n_workers,
             }
             if _metrics.ENABLED:
                 # metric export rides the beat: this process's registry
@@ -1753,15 +1777,10 @@ class NodeDaemon:
                 _M_STORE_SPILLED.set(
                     st.get("spilled", 0), {"node": self.node_id}
                 )
-                _M_TASK_QUEUE.set(
-                    len(self._task_queue), {"node": self.node_id}
-                )
-                _M_IDLE_WORKERS.set(
-                    len(self._idle), {"node": self.node_id}
-                )
+                _M_TASK_QUEUE.set(n_queued, {"node": self.node_id})
+                _M_IDLE_WORKERS.set(n_idle, {"node": self.node_id})
                 delta = _metrics.snapshot_delta()
-                with self._lock:
-                    pushed, self._worker_metrics = self._worker_metrics, []
+                pushed = self._drain_worker_metrics()
                 for d in pushed:
                     _metrics.merge_deltas(delta, d)
                 if delta:
@@ -1781,10 +1800,21 @@ class NodeDaemon:
                         self._worker_metrics.append(delta)
             time.sleep(period)
 
-    def _sample_stats(self) -> dict:
+    def _drain_worker_metrics(self) -> List[dict]:
+        """Swap out the queued worker metric deltas (heartbeat thread).
+        The lock pairs with rpc_metrics_push's append on the rpc loop —
+        the field/thread pair the race sanitizer's seeded
+        ``metrics-push-unlocked`` probe exercises."""
+        with self._lock:
+            pushed, self._worker_metrics = self._worker_metrics, []
+        return pushed
+
+    def _sample_stats(self, n_workers: int) -> dict:
         """Per-node physical stats riding the heartbeat (reference:
         dashboard/modules/reporter/reporter_agent.py sampling psutil into
-        the GCS for the node views)."""
+        the GCS for the node views). ``n_workers`` is the heartbeat's
+        locked snapshot — reading ``self.workers`` here would race the
+        rpc loop."""
         try:
             import psutil
         except ImportError:
@@ -1798,7 +1828,7 @@ class NodeDaemon:
             ("mem_total", lambda: int(psutil.virtual_memory().total)),
             ("load_avg", os.getloadavg),
             ("disk_percent", lambda: psutil.disk_usage("/").percent),
-            ("workers", lambda: len(self.workers)),
+            ("workers", lambda: n_workers),
             ("store_bytes",
              lambda: self.store.stats().get("bytes_in_memory", 0)),
         ):

@@ -488,10 +488,16 @@ class RpcClient:
         except (ConnectionLost, ConnectionError, OSError):
             pass
         finally:
-            self._closed = True
-            # fail all pending calls
-            for mid, fut in list(self._pending.items()):
-                self._pending.pop(mid, None)
+            # fail all pending calls: _closed is published in the same
+            # critical section as the sweep and call_async checks it
+            # under _id_lock, so an insert lands either in this
+            # snapshot (failed here) or after it (raises ConnectionLost
+            # at the caller) — never in the stranded gap between
+            with self._id_lock:
+                self._closed = True
+                stranded = list(self._pending.items())
+                self._pending.clear()
+            for mid, fut in stranded:
                 if not fut.done():
                     fut.set_exception(ConnectionLost("connection lost"))
             if self.on_close:
@@ -510,11 +516,13 @@ class RpcClient:
                     traceback.print_exc()
             return
         mid = msg.get("id")
-        fut = self._pending.pop(mid, None)
+        with self._id_lock:
+            fut = self._pending.pop(mid, None)
+            npending = len(self._pending)
         if fut is not None and _metrics.ENABLED:
             # keep the gauge honest on the way DOWN too, or an idle
             # connection reports its burst high-water mark forever
-            _M_CLIENT_PENDING.set_k(self._m_pending_key, len(self._pending))
+            _M_CLIENT_PENDING.set_k(self._m_pending_key, npending)
         if fut is not None and not fut.done():
             if "error" in msg:
                 etype, estr, tb = msg["error"]
@@ -573,15 +581,22 @@ class RpcClient:
         (reference: actor_submit_queue.h sequence numbers)."""
         from concurrent.futures import Future
 
-        if self._closed:
-            raise ConnectionLost("client closed")
+        fut: Future = Future()
+        # closed-check + insert are one critical section (_id_lock doubles
+        # as the pending-table lock): the reader thread's teardown sweep
+        # snapshots-and-fails _pending, so a future inserted between its
+        # snapshot and a bare closed-check would never be failed and the
+        # caller would hang out its full timeout (race found by the
+        # happens-before sanitizer, analysis/racer.py)
         with self._id_lock:
+            if self._closed:
+                raise ConnectionLost("client closed")
             self._next_id += 1
             mid = self._next_id
-        fut: Future = Future()
-        self._pending[mid] = fut
+            self._pending[mid] = fut
+            npending = len(self._pending)
         if _metrics.ENABLED:
-            _M_CLIENT_PENDING.set_k(self._m_pending_key, len(self._pending))
+            _M_CLIENT_PENDING.set_k(self._m_pending_key, npending)
         msg = {"id": mid, "method": method, "params": params}
         if TRACE is not None:
             msg["_lc"] = TRACE.on_send(self.name, self.peer, method)
@@ -597,13 +612,15 @@ class RpcClient:
                     data = data + data
                 elif act.kind == "reset":
                     self._teardown()
-                    self._pending.pop(mid, None)
+                    with self._id_lock:
+                        self._pending.pop(mid, None)
                     raise ConnectionLost("chaos: injected connection reset")
         try:
             with self._send_lock:
                 self._send_bytes(data)
         except (OSError, ConnectionLost) as e:
-            self._pending.pop(mid, None)
+            with self._id_lock:
+                self._pending.pop(mid, None)
             if isinstance(e, ConnectionLost):
                 raise
             raise ConnectionLost(str(e))
@@ -626,10 +643,11 @@ class RpcClient:
         except FutTimeout:
             # drop the orphaned future so _pending doesn't leak (a late
             # response finds no entry and is ignored)
-            for mid, f in list(self._pending.items()):
-                if f is fut:
-                    self._pending.pop(mid, None)
-                    break
+            with self._id_lock:
+                for mid, f in list(self._pending.items()):
+                    if f is fut:
+                        self._pending.pop(mid, None)
+                        break
             raise RpcTimeout(f"rpc {method} timed out")
 
     def notify(self, method: str, params: Any = None):
@@ -656,7 +674,12 @@ class RpcClient:
             self._send_bytes(data)
 
     def close(self):
-        self._closed = True
+        # _id_lock serializes the flag flip with call_async's
+        # closed-check-and-insert and with the reader's teardown sweep
+        # (race sanitizer finding: two unsynchronized writers on the
+        # shutdown flag)
+        with self._id_lock:
+            self._closed = True
         self._teardown()
 
 

@@ -23,6 +23,13 @@ violations, 160 interleaving-coverage pairs. (Prior ``--dag`` run
 2026-08-03: 75s, 237 tasks, 79 actor calls, 23 PGs, 10 node kills, 20
 compiled-DAG iterations with 3 kill-forced rebuilds, 0 errors, 0
 violations.)
+``--race`` run (2026-08-04, seed 7, whole soak under the happens-before
+race sanitizer — 110 watched fields, every lock/thread/queue/executor
+edge vector-clocked): 45s, 583 tasks, 218 actor calls, 55 PGs, 22 node
+kills, 0 task errors, 0 RACES, 0 invariant violations, 99
+interleaving-coverage pairs. (The racer's first soaks found and fixed 5
+real races — see analysis/racer.py and tests/test_racer.py; this run is
+the clean baseline after those fixes.)
 """
 import argparse
 import random
@@ -56,6 +63,13 @@ ap.add_argument("--bursty", action="store_true",
                      "retry, and every task still terminally resolves — "
                      "typed ClusterOverloadedError outcomes are counted "
                      "separately, never as errors")
+ap.add_argument("--race", action="store_true",
+                help="run the whole soak under the happens-before race "
+                     "sanitizer (analysis/racer.py): every watched "
+                     "control-plane field proxy-instrumented, every "
+                     "lock/thread/queue/executor edge vector-clocked; "
+                     "EXITS 1 on any detected race, with both access "
+                     "stacks in a race-*.jsonl artifact")
 ap.add_argument("--serve", action="store_true",
                 help="mix serve fast-path deployments into the workload: "
                      "bursts of channel-plane requests against "
@@ -85,6 +99,16 @@ else:
 
     _os.close(_fd)
 invariants.install(trace_path)
+
+# --race: the dynamic half of the hybrid race sanitizer rides the whole
+# soak. Installed BEFORE the cluster exists so every lock/thread/queue
+# the control plane allocates is instrumented from birth.
+race_san = None
+if args.race:
+    from ray_tpu.analysis import racer as _racer
+
+    race_san = _racer.RaceSanitizer().install()
+    assert not race_san.unresolved, race_san.unresolved
 
 rng = random.Random(args.seed)  # workload mix (tasks vs actors vs PGs)
 sched = chaos.install(chaos.FaultSchedule(seed=args.seed, rules=[
@@ -363,6 +387,15 @@ print("\n".join(
 ), flush=True)
 
 ray_tpu.shutdown(); cluster.shutdown(); chaos.uninstall()
+races = []
+if race_san is not None:
+    race_san.uninstall()
+    races = race_san.races
+    print("race sanitizer: %d race(s) over %d watched fields"
+          % (len(races), race_san.report()["watched_fields"]), flush=True)
+    if races:
+        print(race_san.format_races(), flush=True)
+        print("race artifact:", race_san.dump("chaos-soak"), flush=True)
 invariants.uninstall()
 violations = invariants.check_trace(trace_path)
 print("protocol trace: %s (%d violations)" % (trace_path, len(violations)),
@@ -394,5 +427,9 @@ if violations or stats["errors"]:
 
     print("flight-recorder black box:",
           save_trace_tail(trace_path, "chaos-soak-error"), flush=True)
+if races:
+    # the race sanitizer's contract mirrors the invariant checker's:
+    # a detected race is a correctness failure, never soak noise
+    raise SystemExit(1)
 if violations:
     raise SystemExit(1)

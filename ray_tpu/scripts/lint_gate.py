@@ -26,6 +26,15 @@ Runs, in order, failing fast with a distinct exit code per contract:
    regression — both ``channel.SEEDED_BUGS`` must be found and shrink
    to <= 12-op replays (artifact: ``memmodel.json``; counterexamples
    land as ``memmodel_replay.json``);
+4b2. optionally (``--race``) the hybrid happens-before race sanitizer
+   (analysis/racer.py): the watchlist round-trip (every STATIC
+   watchlist entry must resolve dynamically — static watchlist ⊆
+   instrumented set), the CLEAN probes (any race found in the live
+   tree fails the gate — fixed, never suppressed, same EMPTY-baseline
+   rule as the linter), and the seeded-bug regression — both
+   ``SEEDED_RACES`` (the re-introduced node_daemon PR 6 fix and the
+   alias-laundered fastpath lock) must be detected within <= 2
+   quiescence rounds with a two-stack report (artifact: ``race.json``);
 4c. optionally (``--serve-storm``) the serve fast-path chaos storm in
    smoke mode (scripts/serve_storm.py): closed-loop traffic under seeded
    replica/node kills, gated on zero lost / duplicate / wrong responses
@@ -87,6 +96,17 @@ def main(argv=None) -> int:
                          "(default 300)")
     ap.add_argument("--memmodel-wall-cap", type=float, default=30.0,
                     help="seconds per channel scenario (default 30)")
+    ap.add_argument("--race", action="store_true",
+                    help="also run the happens-before race sanitizer "
+                         "gate (analysis/racer.py): watchlist "
+                         "round-trip, clean probes (any live race "
+                         "fails), and the seeded-bug detection bar "
+                         "(<= 2 quiescence rounds, two-stack report); "
+                         "artifact: race.json")
+    ap.add_argument("--race-rounds", type=int, default=2,
+                    help="seeded-bug detection bar in quiescence "
+                         "rounds (default 2; detection is "
+                         "deterministic in round 1)")
     ap.add_argument("--serve-storm", action="store_true",
                     help="also run the serve fast-path chaos storm in "
                          "SMOKE mode (scripts/serve_storm.py --smoke): "
@@ -296,6 +316,93 @@ def main(argv=None) -> int:
             return 1
         print(f"memmodel: {total} schedules across "
               f"{len(report['scenarios'])} scenarios, 0 violations")
+
+    # (4b2) happens-before race sanitizer: watchlist round-trip, clean
+    # probes (EMPTY baseline: live races get fixed, never suppressed),
+    # and the seeded-bug regression teeth
+    if args.race:
+        from ray_tpu.analysis import racer as _racer
+
+        failed = False
+        report = {"watchlist": {}, "probes": {}, "seeded": {}}
+        wl = _racer.extract_watchlist()
+        probe = _racer.RaceSanitizer(watchlist=wl)
+        probe.install()
+        probe.uninstall()
+        report["watchlist"] = {
+            "entries": len(wl),
+            "classes": sorted({e["cls"] for e in wl}),
+            "unresolved": [
+                {"entry": e, "error": err} for e, err in probe.unresolved
+            ],
+        }
+        if probe.unresolved:
+            failed = True
+            for e, err in probe.unresolved:
+                print("lint_gate: watchlist entry "
+                      f"{e['cls']}.{e['field']} did not resolve "
+                      f"dynamically: {err} (static watchlist must be a "
+                      "subset of the instrumented set)", file=sys.stderr)
+        else:
+            print(f"race: watchlist round-trips ({len(wl)} entries, "
+                  f"{len(report['watchlist']['classes'])} classes, all "
+                  "instrumented)")
+        for name in sorted(_racer.RACE_PROBES):
+            res = _racer.run_probe(name, rounds=args.race_rounds,
+                                   watchlist=wl)
+            report["probes"][name] = {
+                "rounds": res.rounds,
+                "races": res.races,
+            }
+            if res.detected:
+                failed = True
+                print(f"lint_gate: race probe {name} found a LIVE race "
+                      "— fix it (the baseline stays empty):",
+                      file=sys.stderr)
+                for r in res.races:
+                    print(f"  {r['kind']} on {r['field']}",
+                          file=sys.stderr)
+            else:
+                print(f"race: probe {name} clean "
+                      f"({res.rounds} round(s))")
+        for bug, _mod, pname in _racer.SEEDED_RACES:
+            res = _racer.run_probe(pname, seeded_bugs=[bug],
+                                   rounds=args.race_rounds, watchlist=wl)
+            two_stack = bool(
+                res.races
+                and res.races[0]["prior"].get("stack")
+                and res.races[0]["current"].get("stack")
+            )
+            ok = res.detected and res.rounds <= args.race_rounds \
+                and two_stack
+            report["seeded"][bug] = {
+                "probe": pname,
+                "detected": res.detected,
+                "rounds": res.rounds,
+                "two_stack": two_stack,
+                "static_claim_violated": bool(
+                    res.races and res.races[0]["static_claim_violated"]
+                ),
+            }
+            if not ok:
+                failed = True
+                print(f"lint_gate: seeded race {bug!r} "
+                      + (f"took {res.rounds} rounds (> "
+                         f"{args.race_rounds})" if res.detected
+                         else "NOT DETECTED")
+                      + " — the racer lost its teeth", file=sys.stderr)
+            else:
+                claim = report["seeded"][bug]["static_claim_violated"]
+                print(f"race: seeded bug {bug} detected in "
+                      f"{res.rounds} round(s), two-stack report"
+                      + (", static claim flagged" if claim else ""))
+        with open(os.path.join(args.artifact_dir, "race.json"),
+                  "w") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+        if failed:
+            print("lint_gate: race sanitizer gate failed",
+                  file=sys.stderr)
+            return 1
 
     # (4c) serve fast-path chaos-storm smoke: the SLO gate (zero lost /
     # duplicate / wrong responses under seeded kills) as a CI check

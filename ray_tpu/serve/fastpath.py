@@ -113,6 +113,19 @@ _M_REJECTED = _metrics.Counter(
 )
 _FLUSH_EVERY = 64
 
+#: Test-only regression switch (mirror of ``gcs.SEEDED_BUGS`` /
+#: ``node_daemon.SEEDED_BUGS``): known concurrency-bug shapes the race
+#: sanitizer (analysis/racer.py) re-introduces to prove it still catches
+#: them. Production code never populates this. Names:
+#:
+#: - ``"stats-lock-alias"``: ``_bump`` remakes ``_stats_lock`` per call
+#:   (the alias/``__reduce__``-reconstruction laundering shape that bit
+#:   PR 9) — every caller then holds a DIFFERENT lock object while the
+#:   ``self._stats_lock`` attribute text the static lock-propagation
+#:   rule credits is unchanged. Provably invisible to the static pass;
+#:   the dynamic vector-clock stage must catch it.
+SEEDED_BUGS: set = set()
+
 #: live routers, for serve.shutdown() to sweep (weak: a dropped handle's
 #: router must not be kept alive by this registry)
 _ROUTERS: "weakref.WeakSet" = weakref.WeakSet()
@@ -259,6 +272,16 @@ class FastPathRouter:
     # ------------------------------------------------------------ metrics
 
     def _bump(self, key: str, n: int = 1) -> None:
+        if "stats-lock-alias" in SEEDED_BUGS:
+            # SEEDED BUG (test-only; see SEEDED_BUGS above): the lock is
+            # remade per call, so each caller serializes on its OWN
+            # object — the `self._stats_lock` the static pass credits
+            # no longer names one identity. Dynamically a race; the
+            # pragma keeps the (correct) static claim on file.
+            self._stats_lock = lk = threading.Lock()
+            with lk:
+                self.stats[key] = self.stats.get(key, 0) + n  # ray-lint: disable=cross-thread-field-write
+            return
         with self._stats_lock:
             self.stats[key] += n
 

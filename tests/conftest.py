@@ -77,6 +77,34 @@ def invariant_sanitizer(tmp_path):
 
 
 @pytest.fixture
+def race_sanitizer():
+    """Opt-in happens-before data-race sanitizer (ray_tpu.analysis.racer).
+
+    While installed, every watched control-plane field (the static
+    watchlist: containers/scalars reachable from >= 2 execution
+    contexts in cluster//serve//dag/) is proxy-instrumented and every
+    Lock/RLock/Condition/Thread/Queue/executor edge feeds a FastTrack-
+    style vector-clock engine. At teardown the test FAILS on any
+    detected race, with both access stacks + lock sets in a
+    flight-recorder-style artifact — the dynamic cross-check of the
+    static ``cross-thread-field-write`` model, the same way
+    ``invariant_sanitizer`` cross-checks the protocol model."""
+    from ray_tpu.analysis import racer as _racer
+
+    san = _racer.RaceSanitizer().install()
+    try:
+        yield san
+    finally:
+        san.uninstall()
+        if san.races:
+            dump = san.dump("fixture")
+            assert not san.races, (
+                "data race(s) detected:\n" + san.format_races()
+                + f"\n(artifact: {dump})"
+            )
+
+
+@pytest.fixture
 def lock_sanitizer():
     """Opt-in runtime lock-order recorder (ray_tpu.analysis.sanitizer).
 

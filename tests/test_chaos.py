@@ -246,9 +246,12 @@ def test_job_survives_gcs_kill_restart_midjob(tmp_path, invariant_sanitizer):
         cluster.shutdown()
 
 
-def test_one_way_partition_heals(invariant_sanitizer):
+def test_one_way_partition_heals(invariant_sanitizer, race_sanitizer):
     """A bounded one-way partition (driver->GCS frames dropped for a
-    window) delays but does not fail the job."""
+    window) delays but does not fail the job. Runs under BOTH dynamic
+    sanitizers: the protocol-invariant tracer and the happens-before
+    race detector (every control-plane thread this test spins up is
+    vector-clocked; any unsynchronized watched-field access fails it)."""
     sched = chaos.install(FaultSchedule(seed=3, rules=[
         chaos.partition(src="driver-*", dst="gcs", frm=3, until=6),
     ]))
@@ -268,10 +271,13 @@ def test_one_way_partition_heals(invariant_sanitizer):
         cluster.shutdown()
 
 
-def test_chaos_kill_at_step_with_cluster_registration(invariant_sanitizer):
+def test_chaos_kill_at_step_with_cluster_registration(invariant_sanitizer,
+                                                      race_sanitizer):
     """Cluster.add_node registers each node as a kill target; a kill_at
     rule consulted from the harness loop kills it deterministically and
-    retries carry the job."""
+    retries carry the job. Under the race sanitizer too: node death is
+    the control plane's most thread-crossing path (death sweeps, kill
+    threads, reconnects), so it soaks under the vector clocks here."""
     sched = chaos.install(FaultSchedule(seed=5, rules=[
         chaos.kill_at("soak", at=1, target="victim-node"),
     ]))

@@ -1,0 +1,769 @@
+"""ray_tpu.analysis.racer — hybrid happens-before data-race sanitizer.
+
+Covers: the stage-1 static watchlist (extraction, credited locks,
+pragma semantics, scalar fields), the stage-2 vector-clock core as pure
+units (epoch promotion/demotion, every release/acquire edge kind, the
+read-shared -> write race matrix, byte-identical determinism), the
+install/uninstall zero-overhead contract, the seeded-bug probes (both
+layers, deterministic round-1 detection, two-stack reports, the
+static-claim-violated validation), the shared Condition/RLock
+instrumentation (satellite on sanitizer.py), and the CLI modes
+(--dump-watchlist / --race / kind-dispatched --replay rejection).
+"""
+
+import json
+import queue
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import pytest
+
+from ray_tpu.analysis import racer as racer_mod
+from ray_tpu.analysis import sanitizer as san_mod
+from ray_tpu.analysis.racer import RaceSanitizer, extract_watchlist, run_probe
+
+
+class Shared:
+    """Synthetic watched class for the vector-clock unit tests."""
+
+    def __init__(self):
+        self.table = {}
+        self.items = []
+        self.flag = 0
+
+
+def _wl(*fields, locked=False):
+    return [
+        {"module": "test_racer.py", "cls": "Shared", "field": f,
+         "kind": "scalar" if f == "flag" else "container",
+         "contexts": ["caller", "background thread"],
+         "locked": locked, "locks": ["self._lock"] if locked else []}
+        for f in (fields or ("table", "items", "flag"))
+    ]
+
+
+@pytest.fixture
+def racer():
+    """A racer scoped to the synthetic Shared class."""
+    san = RaceSanitizer(watchlist=_wl())
+    san.install()
+    try:
+        yield san
+    finally:
+        san.uninstall()
+
+
+def _run(*fns):
+    """Run each fn on its own thread, all started before any runs."""
+    go = threading.Event()
+    errs = []
+
+    def wrap(fn):
+        def r():
+            go.wait(5)
+            try:
+                fn()
+            except BaseException as e:  # noqa: BLE001
+                errs.append(e)
+        return r
+
+    ts = [threading.Thread(target=wrap(f)) for f in fns]
+    for t in ts:
+        t.start()
+    go.set()
+    for t in ts:
+        t.join(10)
+    if errs:
+        raise errs[0]
+
+
+def _spin_until(pred, timeout=5.0):
+    """Untracked wait (plain attribute poll): creates NO happens-before
+    edge, which is the point — ordering must come from the sync object
+    under test, or a race is correctly reported."""
+    end = time.monotonic() + timeout
+    while not pred():
+        if time.monotonic() > end:
+            raise AssertionError("spin_until timed out")
+        time.sleep(0.001)
+
+
+# ===================================================== watchlist (stage 1)
+
+
+def test_watchlist_covers_control_plane_fields():
+    wl = extract_watchlist()
+    idx = {(e["cls"], e["field"]): e for e in wl}
+    # the two seeded-bug fields, with their credited locks
+    wm = idx[("NodeDaemon", "_worker_metrics")]
+    assert wm["locked"] and wm["locks"] == ["self._lock"]
+    assert "rpc-handler loop" in wm["contexts"]
+    st = idx[("FastPathRouter", "stats")]
+    assert st["locked"] and st["locks"] == ["self._stats_lock"]
+    # the PR 6 fix fields stay on watch, still credited to _lock
+    assert idx[("NodeDaemon", "_bundles")]["locked"]
+    # entries sort deterministically (byte-identical dumps)
+    assert json.dumps(wl) == json.dumps(extract_watchlist())
+
+
+def test_watchlist_pragma_keeps_static_claim():
+    """The seeded-bug branches are pragma-suppressed, so the watchlist
+    keeps the CLEAN code's locked=True claim — which is exactly what the
+    dynamic stage then flags as static_claim_violated when seeded."""
+    wl = extract_watchlist()
+    idx = {(e["cls"], e["field"]): e for e in wl}
+    assert idx[("NodeDaemon", "_worker_metrics")]["locked"]
+    assert idx[("FastPathRouter", "stats")]["locked"]
+
+
+def test_watchlist_includes_scalar_fields():
+    wl = extract_watchlist()
+    kinds = {(e["cls"], e["field"]): e["kind"] for e in wl}
+    assert kinds.get(("NodeDaemon", "_metrics_seq")) == "scalar"
+    assert kinds.get(("NodeDaemon", "_worker_metrics")) == "container"
+
+
+def test_watchlist_resolves_dynamically():
+    """lint_gate's round-trip: every static watchlist entry must resolve
+    to a live class (static watchlist ⊆ dynamically-instrumented set)."""
+    san = RaceSanitizer()  # full default watchlist
+    san.install()
+    try:
+        assert san.unresolved == []
+        assert san._class_fields  # something actually got instrumented
+    finally:
+        san.uninstall()
+
+
+# ============================================= vector-clock core (stage 2)
+
+
+def test_sibling_writes_race(racer):
+    s = Shared()
+    _run(lambda: s.table.__setitem__("a", 1),
+         lambda: s.table.__setitem__("b", 2))
+    assert racer.found
+    assert racer.races[0]["kind"] == "write-write"
+    # a two-stack report: both sides carry a stack and a vector clock
+    r = racer.races[0]
+    assert r["prior"]["stack"] and r["current"]["stack"]
+    assert r["prior"]["vc"] and r["current"]["vc"]
+
+
+def test_lock_edge_orders_accesses(racer):
+    s = Shared()
+    mu = threading.Lock()
+    done = []
+
+    def a():
+        with mu:
+            s.table["a"] = 1
+        done.append(1)
+
+    def b():
+        _spin_until(lambda: done)  # untracked: no HB edge from this
+        with mu:
+            s.table["b"] = 2
+
+    _run(a, b)
+    assert not racer.found  # the lock release->acquire edge orders them
+
+
+def test_without_lock_same_schedule_races(racer):
+    s = Shared()
+    done = []
+
+    def a():
+        s.table["a"] = 1
+        done.append(1)
+
+    def b():
+        _spin_until(lambda: done)
+        s.table["b"] = 2
+
+    _run(a, b)
+    assert racer.found  # same real-time order, no sync edge -> race
+
+
+def test_thread_start_and_join_edges(racer):
+    s = Shared()
+    s.table["main"] = 0  # main writes before start
+    t = threading.Thread(target=lambda: s.table.__setitem__("t", 1))
+    t.start()   # start edge: main's write ordered before t's
+    t.join()    # join edge: t's write ordered before main's next
+    s.table["main2"] = 2
+    assert not racer.found
+
+
+def test_queue_handoff_edge(racer):
+    s = Shared()
+    q = queue.Queue()
+
+    def producer():
+        s.table["p"] = 1
+        q.put("go")
+
+    def consumer():
+        q.get(timeout=5)
+        s.table["c"] = 2
+
+    _run(producer, consumer)
+    assert not racer.found  # put->get is a release/acquire edge
+
+
+def test_executor_submit_and_result_edges(racer):
+    s = Shared()
+    s.table["before"] = 1
+    with ThreadPoolExecutor(max_workers=1) as ex:
+        fut = ex.submit(lambda: s.table.__setitem__("task", 2))
+        fut.result(timeout=5)
+    s.table["after"] = 3
+    assert not racer.found  # submit edge in, result edge out
+
+
+def test_condition_wait_edge(racer):
+    """Condition.wait's hidden release/reacquire is instrumented through
+    the shared seam: the notifier's write under the condition lock is
+    ordered before the waiter's read after wakeup (the satellite fix —
+    Conditions no longer bypass the instrumentation)."""
+    s = Shared()
+    cv = threading.Condition()
+    ready = []
+
+    def waiter():
+        with cv:
+            while not ready:
+                cv.wait(timeout=5)
+            assert s.table["data"] == 42  # read AFTER the wait edge
+
+    def notifier():
+        _spin_until(lambda: True)
+        with cv:
+            s.table["data"] = 42
+            ready.append(1)
+            cv.notify()
+
+    _run(waiter, notifier)
+    assert not racer.found
+
+
+def test_read_shared_promotion_and_write_demotion(racer):
+    """FastTrack adaptive epochs: two concurrent readers promote the
+    read state to a vector; an ordered write demotes it back to epoch
+    state; an UNordered write against the vector races BOTH readers."""
+    s = Shared()
+    s.table["k"] = 0
+    t1 = threading.Thread(target=lambda: s.table.get("k"))
+    t2 = threading.Thread(target=lambda: s.table.get("k"))
+    t1.start()
+    t2.start()
+    t1.join()
+    t2.join()
+    assert not racer.found
+    fs = racer._obj_states[s.table]
+    assert fs.rvc is not None and len(fs.rvc) >= 2  # promoted
+    s.table["k"] = 1  # main joined both: ordered write
+    assert not racer.found
+    assert fs.rvc is None  # demoted back to epoch state on the write
+
+
+def test_read_write_race_matrix(racer):
+    """read-shared -> concurrent write: the unordered writer races the
+    promoted read vector (read-write), and a later unordered reader
+    races the write epoch (write-read)."""
+    s = Shared()
+    stages = []
+
+    def r1():
+        s.table.get("x")
+        stages.append("r1")
+
+    def r2():
+        _spin_until(lambda: "r1" in stages)
+        s.table.get("x")
+        stages.append("r2")
+
+    def w():
+        _spin_until(lambda: "r2" in stages)
+        s.table["x"] = 1
+        stages.append("w")
+
+    def r3():
+        _spin_until(lambda: "w" in stages)
+        s.table.get("x")
+
+    _run(r1, r2, w, r3)
+    kinds = {r["kind"] for r in racer.races}
+    assert "read-write" in kinds
+    assert "write-read" in kinds
+
+
+def test_defaultdict_vivification_is_a_write(racer):
+    """A missing-key lookup on a watched defaultdict INSERTS — two
+    threads vivifying unsynchronized is the unlocked-shared-index bug
+    class and must race (not read as two concurrent reads)."""
+    from collections import defaultdict
+
+    s = Shared()
+    s.table = defaultdict(set)  # rebind re-wraps through __setattr__
+    _run(lambda: s.table["a"].add(1), lambda: s.table["b"].add(2))
+    assert any(r["kind"] == "write-write" for r in racer.races)
+
+
+def test_defaultdict_vivification_under_lock_clean(racer):
+    from collections import defaultdict
+
+    s = Shared()
+    s.table = defaultdict(set)
+    mu = threading.Lock()
+
+    def one(k):
+        def run():
+            with mu:
+                s.table[k].add(1)
+        return run
+
+    _run(one("a"), one("b"))
+    assert not racer.found
+
+
+def test_leaked_proxy_after_uninstall_is_inert():
+    """A proxy still referenced after uninstall (e.g. a drained
+    snapshot mid-iteration) must neither consult nor record: locks are
+    raw again, so recording would manufacture phantom races."""
+    san = RaceSanitizer(watchlist=_wl())
+    san.install()
+    s = Shared()
+    leaked = s.table  # the proxy object itself
+    san.uninstall()
+    before = racer_mod.CONSULTS
+    _run(lambda: leaked.__setitem__("a", 1),
+         lambda: leaked.__setitem__("b", 2))
+    assert racer_mod.CONSULTS == before
+    assert san.races == []
+
+
+def test_scalar_field_write_tracking(racer):
+    s = Shared()
+    _run(lambda: setattr(s, "flag", 1), lambda: setattr(s, "flag", 2))
+    assert any(r["field"] == "Shared#0.flag"
+               and r["kind"] == "write-write" for r in racer.races)
+
+
+def test_rebind_rewraps_and_slot_races_tracked(racer):
+    """Rebinding a watched container re-proxies the new value, and the
+    attribute SLOT is its own location: two unsynchronized rebinds race
+    (write-write on the slot)."""
+    s = Shared()
+    s.items.append(1)
+    s.items = []  # rebind through the patched __setattr__
+    assert type(s.items) is racer_mod._RaceProxy
+    _run(lambda: setattr(s, "items", []),
+         lambda: setattr(s, "items", [1]))
+    assert any(r["field"] == "Shared#0.items"
+               and r["kind"] == "write-write" for r in racer.races)
+
+
+def test_drain_swap_idiom_is_race_free(racer):
+    """The drain pattern — swap the container out under a lock, iterate
+    the private snapshot outside it — must NOT be flagged: races are
+    per heap object, and the swapped-out object has a single owner."""
+    s = Shared()
+    mu = threading.Lock()
+    done = []
+
+    def producer():
+        for i in range(20):
+            with mu:
+                s.items.append(i)
+        done.append(1)
+
+    def drainer():
+        seen = 0
+        while seen < 20 or not done:
+            with mu:
+                batch, s.items = s.items, []
+            for _ in batch:  # iterated OUTSIDE the lock: private object
+                seen += 1
+            time.sleep(0.001)
+
+    _run(producer, drainer)
+    assert not racer.found, racer.format_races()
+
+
+def test_deterministic_byte_identical_report():
+    """Same schedule -> byte-identical race report (modulo nothing:
+    labels, tids, stacks, clocks and locksets are all deterministic)."""
+    import gc
+
+    def one_run():
+        san = RaceSanitizer(watchlist=_wl())
+        san.install()
+        try:
+            s = Shared()
+            stages = []
+
+            def a():
+                s.table["a"] = 1
+                stages.append("a")
+
+            def b():
+                _spin_until(lambda: stages)
+                s.table["b"] = 2
+
+            # staged schedule: t1's state is created strictly before t2
+            # starts, so tids / clocks are fixed run-to-run
+            t1 = threading.Thread(target=a, name="det-a")
+            t1.start()
+            _spin_until(lambda: stages)
+            t2 = threading.Thread(target=b, name="det-b")
+            t2.start()
+            t2.join(5)
+            t1.join(5)
+            return json.dumps(san.races, sort_keys=True)
+        finally:
+            san.uninstall()
+            gc.collect()
+
+    first = one_run()
+    second = one_run()
+    assert json.loads(first)  # a race was detected at all
+    assert first == second
+
+
+# ==================================== zero-overhead-uninstalled contract
+
+
+def test_uninstalled_zero_consults():
+    s = Shared()
+    before = racer_mod.CONSULTS
+    _run(lambda: s.table.__setitem__("a", 1),
+         lambda: s.table.__setitem__("b", 2))
+    q = queue.Queue()
+    q.put(1)
+    q.get()
+    with ThreadPoolExecutor(max_workers=1) as ex:
+        ex.submit(lambda: None).result()
+    assert racer_mod.CONSULTS == before
+    assert type(s.table) is dict  # no proxy exists anywhere
+
+
+def test_uninstall_restores_everything():
+    import concurrent.futures as cf
+
+    orig = (threading.Lock, threading.Thread.start, queue.Queue.put,
+            cf.ThreadPoolExecutor.submit, cf.Future.result)
+    san = RaceSanitizer(watchlist=_wl())
+    san.install()
+    s = Shared()
+    assert type(s.table) is racer_mod._RaceProxy
+    san.uninstall()
+    assert (threading.Lock, threading.Thread.start, queue.Queue.put,
+            cf.ThreadPoolExecutor.submit, cf.Future.result) == orig
+    assert type(s.table) is dict  # proxies unwrapped on uninstall
+    assert racer_mod.RACER is None
+
+
+def test_single_racer_at_a_time():
+    a = RaceSanitizer(watchlist=_wl())
+    a.install()
+    try:
+        with pytest.raises(RuntimeError, match="already installed"):
+            RaceSanitizer(watchlist=_wl()).install()
+    finally:
+        a.uninstall()
+
+
+def test_proxy_pickles_as_underlying(racer):
+    import pickle
+
+    s = Shared()
+    s.table["k"] = 1
+    out = pickle.loads(pickle.dumps(s.table))
+    assert out == {"k": 1} and type(out) is dict
+
+
+# ================================================= seeded-bug probes
+
+
+def test_probes_clean_without_seeds():
+    wl = extract_watchlist()
+    for name in racer_mod.RACE_PROBES:
+        res = run_probe(name, rounds=3, watchlist=wl)
+        assert not res.detected, res.races
+        assert res.unresolved == []
+
+
+@pytest.mark.parametrize("bug,probe", [
+    (b, p) for b, _m, p in racer_mod.SEEDED_RACES
+])
+def test_seeded_race_detected_deterministically(bug, probe):
+    wl = extract_watchlist()
+    for _ in range(3):  # deterministic: every attempt fires in round 1
+        res = run_probe(probe, seeded_bugs=[bug], rounds=3, watchlist=wl)
+        assert res.detected and res.rounds == 1, res.summary()
+        r = res.races[0]
+        # a two-stack report with lock sets and vector clocks
+        assert r["prior"]["stack"] and r["current"]["stack"]
+        assert "locks" in r["prior"] and "locks" in r["current"]
+        # the field the static pass credited as locked raced anyway:
+        # a finding against the static analysis, with the suggestion
+        assert r["static_claim_violated"]
+        assert "lock identity" in r["suggestion"]
+
+
+def test_seeded_bug_sets_restored_after_probe():
+    from ray_tpu.cluster import node_daemon
+    from ray_tpu.serve import fastpath
+
+    wl = extract_watchlist()
+    run_probe("daemon-metrics-push",
+              seeded_bugs=["metrics-push-unlocked"], watchlist=wl)
+    run_probe("fastpath-stats-alias",
+              seeded_bugs=["stats-lock-alias"], watchlist=wl)
+    assert node_daemon.SEEDED_BUGS == set()
+    assert fastpath.SEEDED_BUGS == set()
+
+
+def test_seeded_race_report_artifact(tmp_path, monkeypatch):
+    """The dump is flight-recorder-shaped: JSONL under artifacts/, a
+    header line then one JSON object per race."""
+    monkeypatch.setenv("RAY_TPU_FLIGHTREC_DIR", str(tmp_path))
+    wl = extract_watchlist()
+    scoped = [e for e in wl if e["cls"] == "NodeDaemon"]
+    from ray_tpu.cluster import node_daemon
+
+    node_daemon.SEEDED_BUGS.add("metrics-push-unlocked")
+    san = RaceSanitizer(watchlist=scoped)
+    san.install()
+    try:
+        racer_mod.RACE_PROBES["daemon-metrics-push"](0)
+    finally:
+        san.uninstall()
+        node_daemon.SEEDED_BUGS.discard("metrics-push-unlocked")
+    assert san.found
+    path = san.dump("test")
+    lines = [json.loads(ln) for ln in
+             open(path, encoding="utf-8").read().splitlines()]
+    assert lines[0]["kind"] == "race-report"
+    assert lines[0]["races"] == len(lines) - 1
+    assert lines[1]["field"].startswith("NodeDaemon#")
+
+
+# ===================== regression: the real races this PR found + fixed
+
+
+def test_rpc_pending_insert_vs_teardown_sweep_not_stranded():
+    """rpc.py regression (racer finding): a call_async racing the
+    reader's teardown sweep must either raise ConnectionLost or get its
+    future failed — never hang stranded in _pending."""
+    from ray_tpu.cluster.rpc import ConnectionLost, RpcClient, RpcServer
+
+    srv = RpcServer(lambda m, p, c: {"ok": True}, host="127.0.0.1",
+                    port=0, name="race-regress")
+    port = srv.start()
+    try:
+        raw = RpcClient("127.0.0.1", port, name="t", peer="race-regress")
+        futs = []
+
+        def submitter():
+            for _ in range(200):
+                try:
+                    futs.append(raw.call_async("ping", {}))
+                except ConnectionLost:
+                    return
+
+        t = threading.Thread(target=submitter)
+        t.start()
+        raw._teardown()
+        t.join(10)
+        raw._reader_thread.join(10)
+        # every accepted future must RESOLVE (result or exception):
+        # before the fix, one inserted between the sweep's snapshot and
+        # the closed flag stayed pending forever
+        deadline = time.time() + 10
+        for f in futs:
+            try:
+                f.result(timeout=max(0.1, deadline - time.time()))
+            except Exception:  # noqa: BLE001 - resolution is the assert
+                pass
+        assert all(f.done() for f in futs)
+    finally:
+        srv.stop()
+
+
+def test_daemon_heartbeat_load_sample_is_locked():
+    """node_daemon regression (racer finding): the heartbeat's load
+    sample reads _task_queue/_idle/workers under _lock now. Static
+    check: no bare len(self._task_queue) outside the lock in
+    _heartbeat_loop."""
+    import ast
+    import inspect
+
+    from ray_tpu.cluster.node_daemon import NodeDaemon
+
+    src = inspect.getsource(NodeDaemon._heartbeat_loop)
+    tree = ast.parse("class _D:\n" + src.replace("\n", "\n ")
+                     if False else
+                     "if 1:\n" + "".join(
+                         " " + ln + "\n" for ln in src.splitlines()))
+    # every len(self.X) read of the shared pools sits under `with
+    # self._lock` (textual containment is enough: the lock block is
+    # the first statement of the loop body)
+    lock_line = None
+    reads = []
+    for i, ln in enumerate(src.splitlines()):
+        if "with self._lock:" in ln:
+            lock_line = lock_line or i
+        for f in ("self._task_queue", "self._idle", "self.workers"):
+            if f"len({f})" in ln:
+                reads.append(i)
+    assert lock_line is not None
+    assert reads and all(i > lock_line for i in reads)
+
+
+def test_client_gc_queue_is_simplequeue():
+    """client.py regression (racer finding): the ref-gc queue is a
+    SimpleQueue — __del__-reentrant-safe producers AND a real
+    happens-before edge into the gc drain thread, instead of relying
+    on GIL-atomic deque ops."""
+    import inspect
+
+    from ray_tpu.cluster.client import ClusterClient
+
+    src = inspect.getsource(ClusterClient.__init__)
+    line = next(ln for ln in src.splitlines() if "_gc_queue" in ln
+                and "=" in ln)
+    assert "SimpleQueue()" in line
+
+
+# ====================================== shared seam: Condition/RLock
+
+
+def test_condition_release_save_maintains_held_stack(lock_sanitizer):
+    """Satellite: Condition's wait-window release/reacquire maintains
+    the shared held stack (it used to bypass it entirely, hiding any
+    Condition-vs-Lock order inversion)."""
+    cv = threading.Condition()  # wrapped RLock under the seam
+    cv.acquire()
+    assert len(san_mod._held_stack()) == 1
+    state = cv._release_save()
+    assert len(san_mod._held_stack()) == 0
+    cv._acquire_restore(state)
+    assert len(san_mod._held_stack()) == 1
+    cv.release()
+    assert len(san_mod._held_stack()) == 0
+
+
+def test_condition_vs_lock_inversion_visible(lock_sanitizer):
+    """A Condition-vs-Lock order inversion is now a recorded cycle."""
+    a = threading.Lock()
+    cv = threading.Condition()
+
+    def fwd():
+        with a:
+            with cv:
+                pass
+
+    def rev():
+        with cv:
+            with a:
+                pass
+
+    for fn in (fwd, rev):
+        t = threading.Thread(target=fn)
+        t.start()
+        t.join()
+    assert lock_sanitizer.cycles()
+
+
+def test_lock_and_race_sanitizers_share_one_seam():
+    """Both sanitizers ride sanitizer.add_listener: installing both
+    patches the factories once; removing one keeps the other live."""
+    from ray_tpu.analysis.sanitizer import LockOrderSanitizer
+
+    orig_lock = threading.Lock
+    lo = LockOrderSanitizer().install()
+    ra = RaceSanitizer(watchlist=_wl()).install()
+    try:
+        assert threading.Lock is not orig_lock
+        lo.uninstall()
+        assert threading.Lock is not orig_lock  # racer still listening
+        lk = threading.Lock()
+        with lk:
+            pass  # exercises the racer's on_acquire/on_release path
+    finally:
+        ra.uninstall()
+        lo.uninstall()
+    assert threading.Lock is orig_lock
+
+
+# ======================================================== CLI modes
+
+
+def _cli(argv):
+    from ray_tpu.analysis.__main__ import main
+
+    return main(argv)
+
+
+def test_cli_dump_watchlist(capsys):
+    rc = _cli(["--dump-watchlist"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    wl = json.loads(out)
+    assert any(e["cls"] == "NodeDaemon"
+               and e["field"] == "_worker_metrics" for e in wl)
+
+
+def test_cli_race_unknown_probe(capsys):
+    assert _cli(["--race", "no-such-probe"]) == 2
+
+
+def test_cli_race_unknown_seed_bug(capsys):
+    """A typo'd --seed-bug must NOT read as 'seeded and clean'."""
+    rc = _cli(["--race", "daemon-metrics-push",
+               "--seed-bug", "no-such-bug"])
+    err = capsys.readouterr().err
+    assert rc == 2
+    assert "unknown seeded race" in err
+
+
+def test_cli_race_seeded_detects(capsys):
+    rc = _cli(["--race", "daemon-metrics-push",
+               "--seed-bug", "metrics-push-unlocked"])
+    out = capsys.readouterr().out
+    assert rc == 1  # a race was found -> nonzero, like --explore
+    assert "RACE" in out and "rpc_metrics_push" in out
+
+
+def test_cli_race_clean_exit_zero(capsys):
+    assert _cli(["--race", "fastpath-stats-alias"]) == 0
+
+
+def test_cli_list_scenarios_includes_racer(capsys):
+    rc = _cli(["--list-scenarios"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "racer:daemon-metrics-push" in out
+    assert "racer:fastpath-stats-alias" in out
+    assert "memmodel:" in out  # the one kind-dispatched block lists all
+
+
+def test_cli_replay_rejects_race_reports(tmp_path, capsys):
+    """Exit-code satellite: --replay is kind-dispatched; a race-report
+    artifact is a report, not a replay, and exits 2 with a clear
+    message instead of crashing into the explorer."""
+    p = tmp_path / "race.json"
+    p.write_text(json.dumps({"kind": "race-report", "races": []}))
+    rc = _cli(["--replay", str(p)])
+    err = capsys.readouterr().err
+    assert rc == 2
+    assert "report" in err
+
+
+def test_cli_replay_rejects_garbage(tmp_path, capsys):
+    p = tmp_path / "not.json"
+    p.write_text("{nope")
+    assert _cli(["--replay", str(p)]) == 2
