@@ -1217,6 +1217,178 @@ def race_overhead_bench():
     }
 
 
+def rpcflow_frame_overhead():
+    """Deterministic per-unit costs of the rpc profiler (analysis/rpcflow),
+    min-of-reps in-process (the BENCH_obs_r01 methodology — wall-clock A/B
+    cannot resolve <3% on this shared 2-CPU box):
+
+    - ``guard_us``: the hot-path cost when NO profiler is installed — the
+      single ``tracing.PROFILE is None`` load the dag/serve entry points
+      pay per iteration (production steady state);
+    - ``op_pair_us``: one op_begin/op_end span pair with the profiler
+      installed (aggregate bump + bounded tracing span), the per-operation
+      cost during a measurement run;
+    - ``send_count_us``: one on_send_bytes frame attribution (per RPC
+      frame, attributed path + per-method tally)."""
+    from ray_tpu.analysis.rpcflow import RpcProfiler
+    from ray_tpu.util import tracing as _tr
+
+    def best_of(fn, reps, tries=5):
+        best = float("inf")
+        for _ in range(tries):
+            t0 = time.perf_counter()
+            fn(reps)
+            best = min(best, (time.perf_counter() - t0) / reps)
+        return best * 1e6
+
+    def guard_loop(reps):
+        for _ in range(reps):
+            p = _tr.PROFILE
+            if p is not None:
+                raise AssertionError
+
+    assert _tr.PROFILE is None
+    guard_us = best_of(guard_loop, 500_000)
+
+    prof = RpcProfiler().install()
+    try:
+        def pair_loop(reps):
+            for _ in range(reps):
+                prof.op_end(prof.op_begin("bench_op"))
+
+        pair_us = best_of(pair_loop, 20_000)
+
+        frame = prof.op_begin("bench_send")
+
+        def send_loop(reps):
+            for _ in range(reps):
+                prof.on_send_bytes("bench_method", 128, "call")
+
+        send_us = best_of(send_loop, 100_000)
+        prof.op_end(frame)
+    finally:
+        prof.uninstall()
+    return {
+        "guard_us": round(guard_us, 4),
+        "op_pair_us": round(pair_us, 3),
+        "send_count_us": round(send_us, 3),
+    }
+
+
+def rpc_budget_bench(dag_iters=400, storm_tasks=300):
+    """ISSUE-16 acceptance bench: the per-operation RPC cost table (the
+    numbers ``.rpc-budget.json`` freezes) plus the profiler's overhead
+    envelope on the two hot planes.
+
+    The <3% GATE is computed from the deterministic micro-costs scaled
+    against the measured baseline iteration (BENCH_obs methodology):
+    uninstalled, the dag hot loop pays ``guard_us`` per iteration;
+    installed (a measurement run), it pays one op span pair. The e2e
+    profiler-on/off A/B is also recorded, but as context — its noise on
+    this box exceeds the effect under test."""
+    import os
+
+    micro = rpcflow_frame_overhead()
+    log(f"rpc_budget: micro {micro}")
+
+    from ray_tpu.analysis import rpcflow as _rf
+
+    res = _rf.measure_rpc_budget(iters=20)
+    budget = _rf.load_budget(
+        os.path.join(_rf.repo_root(), _rf.DEFAULT_BUDGET_FILE))
+    report = _rf.build_rpcflow(["ray_tpu"], root=_rf.repo_root())
+    gate_errors = _rf.check_measured(res["per_op"], budget, report)
+    log(f"rpc_budget: per-op table {res['per_op']}")
+
+    # dag hot loop + driver task storm, profiler off vs on, one cluster
+    import ray_tpu
+    from ray_tpu.analysis.rpcflow import RpcProfiler
+    from ray_tpu.cluster.cluster_utils import Cluster
+    from ray_tpu.dag import InputNode
+
+    ray_tpu.shutdown()
+    cluster = Cluster()
+    cluster.add_node(num_cpus=4)
+    cluster.wait_for_nodes(1)
+    ray_tpu.init(address=cluster.address, config={"log_to_driver": False})
+    compiled = None
+    try:
+        @ray_tpu.remote
+        def _inc(x):
+            return x + 1
+
+        @ray_tpu.remote
+        def _noop(x):
+            return x
+
+        with InputNode() as inp:
+            dag = _inc.bind(inp)
+        compiled = dag.compile()
+
+        def dag_iter_us(n):
+            t0 = time.perf_counter()
+            for i in range(n):
+                compiled.execute(i)
+            return (time.perf_counter() - t0) / n * 1e6
+
+        def storm_tasks_per_sec(n):
+            t0 = time.perf_counter()
+            refs = [_noop.remote(i) for i in range(n)]
+            for r in refs:
+                ray_tpu.get(r)
+            return n / (time.perf_counter() - t0)
+
+        for i in range(50):
+            compiled.execute(i)
+        storm_tasks_per_sec(50)
+        dag_off_us = dag_iter_us(dag_iters)
+        storm_off = storm_tasks_per_sec(storm_tasks)
+        prof = RpcProfiler().install()
+        try:
+            for i in range(20):
+                compiled.execute(i)
+            dag_on_us = dag_iter_us(dag_iters)
+            storm_on = storm_tasks_per_sec(storm_tasks)
+            dag_prof_rpcs = prof.per_op_rpcs().get("dag_execute", -1.0)
+        finally:
+            prof.uninstall()
+    finally:
+        if compiled is not None:
+            try:
+                compiled.teardown()
+            except Exception:  # noqa: BLE001
+                pass
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+    base = min(dag_on_us, dag_off_us)
+    off_pct = micro["guard_us"] / base * 100.0
+    on_pct = micro["op_pair_us"] / base * 100.0
+    # storm: per task the driver pays one submit span + one get span +
+    # ~3 frame attributions (submit_task, task_done push, result chatter)
+    task_us = 1e6 / max(storm_off, storm_on)
+    storm_pct = (2 * micro["op_pair_us"] + 3 * micro["send_count_us"]) \
+        / task_us * 100.0
+    return {
+        **micro,
+        "per_op_rpcs": res["per_op"],
+        "budget_gate_errors": gate_errors,
+        "dag_baseline_iter_us": round(base, 1),
+        "dag_overhead_uninstalled_pct": round(off_pct, 4),
+        "dag_overhead_installed_pct": round(on_pct, 3),
+        "storm_overhead_installed_pct": round(storm_pct, 3),
+        "meets_3pct_bar": on_pct < 3.0 and storm_pct < 3.0
+        and off_pct < 3.0,
+        "dag_profiled_rpcs_per_iter": dag_prof_rpcs,
+        "e2e_dag_on_iter_us": round(dag_on_us, 1),
+        "e2e_dag_off_iter_us": round(dag_off_us, 1),
+        "e2e_dag_overhead_pct_noisy": round(
+            (dag_on_us / dag_off_us - 1.0) * 100.0, 2),
+        "e2e_storm_on_tasks_per_sec": round(storm_on, 1),
+        "e2e_storm_off_tasks_per_sec": round(storm_off, 1),
+    }
+
+
 def serve_storm_bench(duration_s=20.0, clients=48, replicas=3, seed=7):
     """ISSUE-12 acceptance bench (recorded as BENCH_serve_rNN.json):
 
@@ -1374,6 +1546,26 @@ def main():
             "value": r["dag_dispatch_overhead_pct"],
             "unit": "% (compiled dag iter, metrics+recorder on vs off)",
             "configs": {"obs_overhead": r},
+        }))
+        return
+
+    if sys.argv[1:] == ["rpc_budget"]:
+        # rpc-cost-table + profiler-overhead gate — prints one JSON line
+        # (recorded as BENCH_rpcflow_rNN.json); bars: measured per-op
+        # frames fit the committed budget, profiler <3% on the dag hot
+        # loop (installed AND uninstalled) and the driver task storm
+        r = rpc_budget_bench()
+        log(f"rpc_budget dag installed {r['dag_overhead_installed_pct']}% "
+            f"(uninstalled {r['dag_overhead_uninstalled_pct']}%), storm "
+            f"{r['storm_overhead_installed_pct']}%, "
+            f"gate_errors={len(r['budget_gate_errors'])}")
+        print(json.dumps({
+            "metric": "rpcflow_dag_overhead_installed_pct",
+            "value": r["dag_overhead_installed_pct"],
+            "unit": "% (op-span pair cost vs compiled dag iter; bars: "
+                    "<3% dag+storm, measured per-op frames fit "
+                    ".rpc-budget.json)",
+            "configs": {"rpc_budget": r},
         }))
         return
 

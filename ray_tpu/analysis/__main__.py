@@ -36,6 +36,10 @@ def main(argv=None) -> int:
     p.add_argument("--select", default=None, metavar="CHECKS",
                    help="comma-separated subset of checks to run")
     p.add_argument("--list-checks", action="store_true")
+    p.add_argument("--dump-rpcflow", action="store_true",
+                   help="print the per-operation RPC cost table "
+                        "(interprocedural call-graph multiplicity "
+                        "analysis, analysis/rpcflow.py) and exit")
     p.add_argument("--dump-protocol", action="store_true",
                    help="instead of linting, emit the extracted RPC "
                         "protocol model (handlers, call sites, push/"
@@ -338,6 +342,21 @@ def main(argv=None) -> int:
             print(v.format())
         print(f"{len(violations)} invariant violation(s)")
         return 1 if violations else 0
+
+    if args.dump_rpcflow:
+        from ray_tpu.analysis.rpcflow import build_rpcflow, format_rpcflow
+
+        paths = [p_ for p_ in args.paths if os.path.exists(p_)]
+        missing = [p_ for p_ in args.paths if not os.path.exists(p_)]
+        if missing or not paths:
+            print(f"error: no such path(s): {missing}", file=sys.stderr)
+            return 2
+        report = build_rpcflow(paths, root=os.getcwd())
+        if args.format == "json":
+            print(json.dumps(report.to_dict(), indent=2))
+        else:
+            print(format_rpcflow(report))
+        return 2 if report.unresolved_entries else 0
 
     if args.dump_protocol:
         from ray_tpu.analysis.protocol import extract_protocol

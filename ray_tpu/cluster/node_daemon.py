@@ -1018,12 +1018,30 @@ class NodeDaemon:
             )
             with self._lock:
                 self._actor_tasks.pop(task_id, None)
-            for oid, _ in payload["results"]:
+            # actor results bypass task_done's batched directory add (the
+            # future above answers the driver directly), so the daemon
+            # publishes their locations itself — in ONE batched frame, on
+            # the async path (_report_done runs on the event loop here)
+            from ray_tpu.cluster import gcs as gcs_mod
+
+            oids = [oid for oid, _ in payload["results"]]
+            if "per-object-location-loop" in gcs_mod.SEEDED_BUGS:
+                # SEEDED BUG (test-only; see gcs.SEEDED_BUGS): the
+                # pre-batching N+1 — one add_object_location frame per
+                # result. rpc-in-loop must flag it statically and the rpc
+                # profiler must catch the budget breach dynamically.
+                for oid in oids:
+                    try:
+                        self.gcs.call_async("add_object_location", {  # ray-lint: disable=rpc-in-loop
+                            "object_id": oid, "node_id": self.node_id,
+                        }).add_done_callback(log_rpc_failure)
+                    except Exception:
+                        pass
+                return
+            if oids:
                 try:
-                    # _report_done runs on the event loop for actor calls
-                    # too — publish locations without blocking it
                     self.gcs.call_async("add_object_location", {
-                        "object_id": oid, "node_id": self.node_id,
+                        "object_ids": oids, "node_id": self.node_id,
                     }).add_done_callback(log_rpc_failure)
                 except Exception:
                     pass

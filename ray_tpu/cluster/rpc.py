@@ -598,9 +598,17 @@ class RpcClient:
         if _metrics.ENABLED:
             _M_CLIENT_PENDING.set_k(self._m_pending_key, npending)
         msg = {"id": mid, "method": method, "params": params}
-        if TRACE is not None:
-            msg["_lc"] = TRACE.on_send(self.name, self.peer, method)
+        t = TRACE
+        if t is not None:
+            msg["_lc"] = t.on_send(self.name, self.peer, method)
         data = frame_bytes(msg)
+        if t is not None:
+            # richer optional hook (rpc profiler): frame size + send kind
+            # aren't in on_send's signature, and widening it would break
+            # every installed tracer
+            osb = getattr(t, "on_send_bytes", None)
+            if osb is not None:
+                osb(method, len(data), "call")
         if CHAOS is not None:
             act = CHAOS.on_client_send(self.name, self.peer, method)
             if act is not None:
@@ -655,9 +663,14 @@ class RpcClient:
         if self._closed:
             raise ConnectionLost("client closed")
         msg = {"method": method, "params": params}
-        if TRACE is not None:
-            msg["_lc"] = TRACE.on_send(self.name, self.peer, method)
+        t = TRACE
+        if t is not None:
+            msg["_lc"] = t.on_send(self.name, self.peer, method)
         data = frame_bytes(msg)
+        if t is not None:
+            osb = getattr(t, "on_send_bytes", None)
+            if osb is not None:
+                osb(method, len(data), "notify")
         if CHAOS is not None:
             act = CHAOS.on_client_send(self.name, self.peer, method)
             if act is not None:

@@ -37,6 +37,7 @@ from typing import Any, Dict, List, Optional
 
 from ray_tpu.core import serialization
 from ray_tpu.core.task_spec import new_id
+from ray_tpu.util import tracing as _tracing
 from ray_tpu.dag.api import (
     ClassMethodNode,
     DAGNode,
@@ -411,6 +412,18 @@ class CompiledDAG:
         channel(s); no GCS traffic. Returns the output value (list of
         values for a MultiOutputNode target); raises the stage's exception
         if the iteration failed, ChannelClosedError if the pipeline died."""
+        # explicit guard instead of op_span(): this is the hot loop, and
+        # the no-profiler path must stay one attribute load
+        p = _tracing.PROFILE
+        if p is None:
+            return self._execute_inner(input_args, timeout)
+        frame = p.op_begin("dag_execute")
+        try:
+            return self._execute_inner(input_args, timeout)
+        finally:
+            p.op_end(frame)
+
+    def _execute_inner(self, input_args, timeout):
         if self._torn_down:
             raise ChannelClosedError(f"dag {self.dag_id[:12]} is torn down")
         if self._poisoned:

@@ -32,6 +32,7 @@ real races — see analysis/racer.py and tests/test_racer.py; this run is
 the clean baseline after those fixes.)
 """
 import argparse
+import os
 import random
 import time
 
@@ -109,6 +110,17 @@ if args.race:
 
     race_san = _racer.RaceSanitizer().install()
     assert not race_san.unresolved, race_san.unresolved
+
+# Per-operation RPC accounting rides the whole soak (analysis/rpcflow):
+# installed LAST so it wraps whichever tracer is active (the invariant
+# file tracer, or the race sanitizer when --race) and delegates every
+# hook to it. The exit table prints frames/op against the committed
+# budget; an order-of-magnitude breach fails the soak.
+from ray_tpu.analysis import rpcflow as _rpcflow
+
+rpc_prof = _rpcflow.RpcProfiler().install()
+rpc_budget = _rpcflow.load_budget(
+    os.path.join(_rpcflow.repo_root(), _rpcflow.DEFAULT_BUDGET_FILE))
 
 rng = random.Random(args.seed)  # workload mix (tasks vs actors vs PGs)
 sched = chaos.install(chaos.FaultSchedule(seed=args.seed, rules=[
@@ -387,6 +399,7 @@ print("\n".join(
 ), flush=True)
 
 ray_tpu.shutdown(); cluster.shutdown(); chaos.uninstall()
+rpc_prof.uninstall()  # first in, last out: restores the wrapped tracer
 races = []
 if race_san is not None:
     race_san.uninstall()
@@ -411,6 +424,28 @@ from ray_tpu.analysis.explore import interleaving_coverage
 pairs = interleaving_coverage(invariants.read_trace(trace_path))
 print("interleaving coverage: %d distinct handler-pair orderings "
       "observed at the GCS" % len(pairs), flush=True)
+# per-operation RPC table: frames/op over the whole soak vs the committed
+# budget. Chaos repair traffic (reroutes, resend-after-reset, reroute
+# re-registration) legitimately exceeds the quiet steady-state ceiling,
+# so the soak only FAILS on an order-of-magnitude breach (> 3x budget
+# + 1 — the N+1 regrowth class); the exact ceiling is enforced on a
+# quiet cluster by `lint_gate --rpc-budget`.
+rpc_per_op = rpc_prof.per_op_rpcs()
+rpc_snap = rpc_prof.snapshot()
+print("per-operation RPC table (frames/op over the soak):", flush=True)
+print("  " + _rpcflow.budget_table(rpc_per_op).replace("\n", "\n  "),
+      flush=True)
+print("  unattributed (background planes): %d calls, %d pushes"
+      % (rpc_snap["unattributed"]["calls"],
+         rpc_snap["unattributed"]["pushes"]), flush=True)
+rpc_over = []
+for _op, _entry in sorted(rpc_budget.items()):
+    _got = rpc_per_op.get(_op)
+    if _got is not None and _got > float(_entry["rpcs"]) * 3 + 1:
+        rpc_over.append("%s: %.2f frames/op vs budget %g (>3x+1)"
+                        % (_op, _got, float(_entry["rpcs"])))
+for _line in rpc_over:
+    print("RPC BUDGET BREACH: " + _line, flush=True)
 print("SOAK DONE; task errors:", stats["errors"], flush=True)
 if serve_h is not None and (serve_dups or stats["serve_lost"]):
     # exactly-once delivery is the --serve mix's contract: any duplicate
@@ -432,4 +467,8 @@ if races:
     # a detected race is a correctness failure, never soak noise
     raise SystemExit(1)
 if violations:
+    raise SystemExit(1)
+if rpc_over:
+    # an order-of-magnitude per-op frame breach means a hot path regrew
+    # an N+1 (or lost its batching) — a regression, not chaos noise
     raise SystemExit(1)

@@ -35,6 +35,13 @@ Runs, in order, failing fast with a distinct exit code per contract:
    ``SEEDED_RACES`` (the re-introduced node_daemon PR 6 fix and the
    alias-laundered fastpath lock) must be detected within <= 2
    quiescence rounds with a two-stack report (artifact: ``race.json``);
+4b3. optionally (``--rpc-budget``) the per-operation RPC budget ratchet
+   (analysis/rpcflow.py): the interprocedural cost table must build with
+   no unresolved entries, the committed ``.rpc-budget.json`` must pass
+   the ratchet rules (zero-ops pinned at 0, >= 8 budgeted ops), and a
+   live re-measurement on an embedded cluster must fit BOTH the
+   committed budget and the statically-predicted multiplicity class per
+   op (artifact: ``rpc_budget.json``);
 4c. optionally (``--serve-storm``) the serve fast-path chaos storm in
    smoke mode (scripts/serve_storm.py): closed-loop traffic under seeded
    replica/node kills, gated on zero lost / duplicate / wrong responses
@@ -45,8 +52,8 @@ Runs, in order, failing fast with a distinct exit code per contract:
 
 Artifacts land in ``--artifact-dir`` (default ``artifacts/``):
 ``lint.json`` (machine-readable findings), ``protocol.json`` (the dumped
-model), ``memmodel.json`` (when --memmodel ran), ``tier1_durations.txt``
-(when --tier1 ran).
+model), ``memmodel.json`` (when --memmodel ran), ``rpc_budget.json``
+(when --rpc-budget ran), ``tier1_durations.txt`` (when --tier1 ran).
 """
 
 from __future__ import annotations
@@ -107,6 +114,17 @@ def main(argv=None) -> int:
                     help="seeded-bug detection bar in quiescence "
                          "rounds (default 2; detection is "
                          "deterministic in round 1)")
+    ap.add_argument("--rpc-budget", action="store_true",
+                    help="also run the per-operation RPC budget ratchet "
+                         "(analysis/rpcflow.py): static cost table, "
+                         "committed-budget ratchet rules, and a live "
+                         "re-measurement on an embedded cluster gated "
+                         "on budget AND predicted multiplicity class; "
+                         "artifact: rpc_budget.json")
+    ap.add_argument("--rpc-budget-iters", type=int, default=12,
+                    help="measured iterations per driver operation "
+                         "(default 12; a warmup pass always precedes "
+                         "the measured pass)")
     ap.add_argument("--serve-storm", action="store_true",
                     help="also run the serve fast-path chaos storm in "
                          "SMOKE mode (scripts/serve_storm.py --smoke): "
@@ -403,6 +421,61 @@ def main(argv=None) -> int:
             print("lint_gate: race sanitizer gate failed",
                   file=sys.stderr)
             return 1
+
+    # (4b3) per-operation RPC budget ratchet: static cost table ->
+    # committed budget rules -> live re-measurement (the honesty gate:
+    # measured frames must fit the budget AND the predicted class)
+    if args.rpc_budget:
+        from ray_tpu.analysis import rpcflow as _rpcflow
+
+        failed = False
+        budget_path = os.path.join(REPO, _rpcflow.DEFAULT_BUDGET_FILE)
+        report = _rpcflow.build_rpcflow(["ray_tpu"], root=REPO)
+        art = {"ops": {op: c.to_dict() for op, c in report.ops.items()}}
+        if report.unresolved_entries:
+            failed = True
+            for op, why in report.unresolved_entries:
+                print(f"lint_gate: rpcflow entry point {op} unresolved: "
+                      f"{why}", file=sys.stderr)
+        try:
+            budget = _rpcflow.load_budget(budget_path)
+        except (OSError, ValueError) as e:
+            print(f"lint_gate: cannot load committed RPC budget: {e}",
+                  file=sys.stderr)
+            return 1
+        art["budget"] = budget
+        errs = _rpcflow.ratchet_check(budget, budget)
+        if len(budget) < 8:
+            errs.append(f"budget table has {len(budget)} ops, need >= 8")
+        for e in errs:
+            failed = True
+            print(f"lint_gate: rpc budget: {e}", file=sys.stderr)
+        if not failed:
+            print(f"rpc-budget: static table ok "
+                  f"({len(report.ops)} ops over "
+                  f"{report.functions_indexed} functions), committed "
+                  f"budget ok ({len(budget)} ops, "
+                  f"{', '.join(_rpcflow.ZERO_STEADY_STATE_OPS)} at 0)")
+        measured = None
+        if not failed:
+            res = _rpcflow.measure_rpc_budget(
+                iters=args.rpc_budget_iters)
+            measured = res["per_op"]
+            art["measured"] = measured
+            art["profile"] = res["snapshot"]
+            for e in _rpcflow.check_measured(measured, budget, report):
+                failed = True
+                print(f"lint_gate: rpc budget: {e}", file=sys.stderr)
+        with open(os.path.join(args.artifact_dir, "rpc_budget.json"),
+                  "w") as f:
+            json.dump(art, f, indent=2, sort_keys=True)
+        if failed:
+            print("lint_gate: rpc budget gate failed", file=sys.stderr)
+            return 1
+        print("rpc-budget: measured frames fit the committed budget "
+              "and the predicted classes:")
+        print("  " + _rpcflow.budget_table(measured, report)
+              .replace("\n", "\n  "))
 
     # (4c) serve fast-path chaos-storm smoke: the SLO gate (zero lost /
     # duplicate / wrong responses under seeded kills) as a CI check

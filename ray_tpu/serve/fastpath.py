@@ -79,6 +79,7 @@ from ray_tpu.dag.channel import (
     ChannelTimeoutError,
 )
 from ray_tpu.util import metrics as _metrics
+from ray_tpu.util import tracing as _tracing
 
 _M_REQ_SECONDS = _metrics.Histogram(
     "ray_tpu_serve_request_seconds",
@@ -450,6 +451,18 @@ class FastPathRouter:
 
     def submit(self, method: Optional[str], args, kwargs,
                deadline_s: Optional[float] = None) -> FastPathResponse:
+        # hot path: explicit guard, not op_span() (see dag execute)
+        p = _tracing.PROFILE
+        if p is None:
+            return self._submit_inner(method, args, kwargs, deadline_s)
+        frame = p.op_begin("serve_request")
+        try:
+            return self._submit_inner(method, args, kwargs, deadline_s)
+        finally:
+            p.op_end(frame)
+
+    def _submit_inner(self, method: Optional[str], args, kwargs,
+                      deadline_s: Optional[float] = None) -> FastPathResponse:
         if self._closed:
             raise RuntimeError("serve fast-path router is shut down")
         self._ensure_refresher()

@@ -28,6 +28,28 @@ _spans: "deque" = deque(maxlen=_MAX_SPANS)
 _lock = threading.Lock()
 _installed = False
 
+#: per-operation RPC profiler seam (analysis/rpcflow.RpcProfiler installs
+#: itself here). Same zero-overhead discipline as rpc.TRACE: driver entry
+#: points guard with a module-global `is None` check, so the hot paths
+#: (dag execute, serve fast-path submit) pay one attribute load when off.
+PROFILE = None
+
+
+@contextlib.contextmanager
+def op_span(name: str):
+    """Profiler operation span for driver entry points. No-op (one global
+    load) when no profiler is installed; hot loops that can't afford the
+    generator frame use the explicit `PROFILE is None` guard instead."""
+    p = PROFILE
+    if p is None:
+        yield
+        return
+    frame = p.op_begin(name)
+    try:
+        yield
+    finally:
+        p.op_end(frame)
+
 
 def tracing_enabled() -> bool:
     return os.environ.get("RAY_TPU_TRACING_ENABLED", "0").lower() in (
