@@ -1442,6 +1442,20 @@ def overload_storm_bench(seed=7):
     return run_storm(seed=seed)
 
 
+def gray_storm_bench(seed=7):
+    """ISSUE-17 acceptance bench (recorded as BENCH_gray_rNN.json):
+    barrier-wave gangs on a 5-node cluster with 2 nodes chaos-slowed
+    25x (ALIVE on heartbeats — gray failure), A/B over the gray-failure
+    defense plane. Bars: defense-ON p99 >= 3x better than OFF, goodput
+    >= 2x OFF, every submission terminally resolved, the wedged-forever
+    gang (factor=inf) rescued by speculation within its deadline, >= 1
+    node quarantined, strict-terminal invariant trace clean (incl.
+    exactly-one winning task_done apply + loser cancel-conservation)."""
+    from ray_tpu.scripts.gray_storm import run_storm
+
+    return run_storm(seed=seed)
+
+
 def _tpu_available(timeout_s: float = 120.0) -> bool:
     """Probe the TPU in a SUBPROCESS: a wedged axon tunnel hangs
     jax.devices() forever inside this process, which would take the whole
@@ -1598,6 +1612,24 @@ def main():
             "unit": "x (within-SLO goodput, control ON vs OFF, same "
                     "seeded burst trace + chaos)",
             "configs": {"overload_storm": r},
+        }))
+        return
+
+    if sys.argv[1:] == ["gray_storm"]:
+        # gray-failure acceptance bench: 2-of-5-slow-nodes A/B storm —
+        # prints one JSON line (recorded as BENCH_gray_rNN.json); pure
+        # host python, no TPU probe
+        r = gray_storm_bench()
+        log(f"gray_storm p99 ratio {r['p99_ratio_off_on']}x, goodput "
+            f"ratio {r['goodput_ratio_on_off']}x, quarantined "
+            f"{r['on_quarantined']}, spec launches "
+            f"{r['speculative_launches']}, pass {r['storm_pass']}")
+        print(json.dumps({
+            "metric": "gray_p99_ratio_off_on",
+            "value": r["p99_ratio_off_on"],
+            "unit": "x (p99 task latency, defense OFF vs ON, same "
+                    "seeded 2-of-5-slow trace)",
+            "configs": {"gray_storm": r},
         }))
         return
 

@@ -84,6 +84,14 @@ METHOD_TABLE: Dict[str, str] = {
     # terminally resolves), drain marks a node unschedulable while its
     # running tasks bleed off
     "drain_node": "node unschedulable marking (graceful drain)",
+    # gray-failure defense plane: quarantine is the reversible drain-mask
+    # twin (probe-verified recovery instead of terminate); probe results
+    # drive the QUARANTINED -> PROBATION exit. Speculative executions ride
+    # the dispatch/release ledger under per-copy keys (task~sN) with
+    # exactly-one winning task_done apply and cancel-conservation on the
+    # losers (see _on_dispatch/_on_spec_cancel/_on_spec_promote)
+    "quarantine_node": "reversible node quarantine mask (gray defense)",
+    "probe_result": "quarantine recovery probing (gray defense)",
     # compiled DAGs (ray_tpu/dag): stage capacity holds follow the same
     # dispatch/release ledger as tasks; channel frames follow the per-edge
     # seq-alternation invariant (chan_write/chan_read apply events emitted
@@ -282,6 +290,12 @@ class InvariantChecker:
         self.wiped: set = set()  # ledger keys erased by node death/reset
         # exactly-once: task -> node of the outstanding dispatch
         self.outstanding: Dict[str, str] = {}
+        # straggler speculation: task -> {spec ledger key -> node} of
+        # outstanding speculative copies. Every copy must end as the
+        # winner (task_done from its node), a spec_cancel loser, a
+        # spec_promote (new primary), or a node_dead wipe — anything
+        # left at a strict_terminal check leaked a capacity hold
+        self.spec_out: Dict[str, Dict[str, str]] = {}
         # PG 2PC daemon-side state per (node, pg, bundle)
         self.pg2pc: Dict[Tuple, str] = {}
         # actor ordering: (owner, actor, worker) -> last seq
@@ -363,6 +377,13 @@ class InvariantChecker:
         for task, n in list(self.outstanding.items()):
             if n == node:
                 del self.outstanding[task]
+        # speculative copies hosted on the dead node die with it (their
+        # ledger entries were wiped above; no cancel/release follows)
+        for task, m in list(self.spec_out.items()):
+            for k in [k for k, n in m.items() if n == node]:
+                del m[k]
+            if not m:
+                del self.spec_out[task]
 
     # -------------------------------------------------------------- apply
 
@@ -385,6 +406,11 @@ class InvariantChecker:
                           f"task {task} admitted but never terminally "
                           "resolved (admission conservation: a silent "
                           "drop or a stranded queue entry)")
+            for task in sorted(self.spec_out):
+                self._bad("speculation", clock,
+                          f"speculative copies of {task} never resolved "
+                          "(no win, cancel, promote, or node wipe): "
+                          f"{sorted(self.spec_out[task])}")
         return self.violations
 
     def _on_node(self, ev: Dict) -> None:
@@ -405,6 +431,23 @@ class InvariantChecker:
 
     def _on_dispatch(self, ev: Dict) -> None:
         task, node = ev["task"], ev["node"]
+        if ev.get("speculative"):
+            # straggler speculation: a concurrent SECOND execution of the
+            # same task is legal — under its OWN ledger key (task~sN), so
+            # capacity conservation still pairs per execution — but only
+            # while the primary dispatch is outstanding
+            key = ev.get("key") or f"{task}~s?"
+            if task not in self.outstanding:
+                self._bad("speculation", ev["c"],
+                          f"speculative copy {key!r} launched with no "
+                          "outstanding primary dispatch")
+            if not self.node_alive.get(node, False):
+                self._bad("capacity", ev["c"],
+                          f"speculative copy {key!r} dispatched to "
+                          f"dead/unknown node {node}")
+            self.spec_out.setdefault(task, {})[key] = node
+            self._alloc(ev["c"], node, key, self._res(ev.get("res")))
+            return
         if task in self.outstanding:
             self._bad("exactly-once", ev["c"],
                       f"task {task} dispatched to {node} while an earlier "
@@ -427,6 +470,57 @@ class InvariantChecker:
                       "dispatch — a resend/duplicate escaped the dedupe")
             return
         del self.outstanding[task]
+        # a speculative copy on the REPORTING node is the winner: its
+        # ledger entry releases through the normal release event; every
+        # other copy must follow with a spec_cancel (checked terminal)
+        m = self.spec_out.get(task)
+        if m:
+            for k in [k for k, n in m.items() if n == ev.get("node")]:
+                del m[k]
+            if not m:
+                del self.spec_out[task]
+
+    def _on_spec_cancel(self, ev: Dict) -> None:
+        """A losing execution of a speculated task was cancelled. The
+        capacity release rides a paired ``release`` event under the same
+        ledger key; here we retire the speculation bookkeeping —
+        cancel-conservation: each copy cancels at most once."""
+        task, key = ev["task"], ev.get("key")
+        if key == task:
+            return  # the PRIMARY lost to a copy: outstanding already
+            # resolved by the winning task_done apply
+        m = self.spec_out.get(task)
+        if m is None or key not in m:
+            self._bad("speculation", ev["c"],
+                      f"spec_cancel for {key!r} with no outstanding "
+                      "speculative copy (double-cancel or phantom)")
+            return
+        del m[key]
+        if not m:
+            del self.spec_out[task]
+
+    def _on_spec_promote(self, ev: Dict) -> None:
+        """The primary's node died with a speculative copy surviving: the
+        copy becomes the primary (its ledger key carries over — the
+        eventual release pairs against it)."""
+        task, node, key = ev["task"], ev["node"], ev.get("key")
+        m = self.spec_out.get(task)
+        if m is None or key not in m:
+            self._bad("speculation", ev["c"],
+                      f"spec_promote of {key!r} which is not an "
+                      "outstanding speculative copy")
+        else:
+            del m[key]
+            if not m:
+                del self.spec_out[task]
+        if task in self.outstanding:
+            self._bad("speculation", ev["c"],
+                      f"spec_promote of {task} while a primary dispatch "
+                      "is still outstanding (promotion without a wipe)")
+        self.outstanding[task] = node
+
+    def _on_node_quarantine(self, ev: Dict) -> None:
+        pass  # informational; capacity semantics ride release events
 
     def _on_task_done_dup(self, ev: Dict) -> None:
         pass  # informational: a dedup that worked

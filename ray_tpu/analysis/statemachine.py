@@ -65,6 +65,15 @@ STATE_FIELD: Dict[str, str] = {
     "bundle": "state", "node": "alive",
 }
 
+#: secondary lifecycle fields riding on an existing entity row:
+#: (row entity, field name) -> machine entity. The node row carries two
+#: machines — liveness (``alive``, bool) and gray-failure health
+#: (``health``, string) — and the extractor routes each field's writes
+#: to its own machine.
+FIELD_MACHINES: Dict[Tuple[str, str], str] = {
+    ("node", "health"): "node-health",
+}
+
 #: modules the extractor applies to (basename match)
 STATE_MODULES = ("gcs.py", "node_daemon.py")
 
@@ -152,6 +161,25 @@ MACHINES: Dict[str, StateMachine] = {
         states=["ALIVE", "DEAD"],  # the boolean `alive` field
         initial=["ALIVE"],
         edges=[("ALIVE", "DEAD"), ("DEAD", "ALIVE")],
+    ),
+    # gray-failure defense plane (gcs._gray_sweep + quarantine helpers):
+    # an ALIVE node's health rides the suspicion score through
+    # OK -> SUSPECT -> QUARANTINED -> PROBATION -> OK, with instant
+    # relapse from PROBATION and manual quarantine from any pre-mask
+    # state (rpc_quarantine_node).
+    "node-health": _m(
+        "node-health",
+        states=["OK", "SUSPECT", "QUARANTINED", "PROBATION"],
+        initial=["OK"],
+        edges=[
+            ("OK", "SUSPECT"),            # score crossed quarantine_high
+            ("SUSPECT", "OK"),            # decayed below quarantine_low
+            ("SUSPECT", "QUARANTINED"),   # sustained over N sweeps
+            ("OK", "QUARANTINED"),        # manual rpc_quarantine_node
+            ("QUARANTINED", "PROBATION"), # clean probes earned exit
+            ("PROBATION", "OK"),          # probation served clean
+            ("PROBATION", "QUARANTINED"), # relapse: straight back
+        ],
     ),
     "job": _m(
         "job",
@@ -341,7 +369,9 @@ class _FuncExtractor(ast.NodeVisitor):
                 continue
             entity, field = ent_field
             if field != STATE_FIELD.get(entity):
-                continue
+                entity = FIELD_MACHINES.get((entity, field))
+                if entity is None:
+                    continue
             comp = t.comparators[0]
             states: Set[str] = set()
             if isinstance(op, ast.Eq):
@@ -399,12 +429,19 @@ class _FuncExtractor(ast.NodeVisitor):
                             d: ast.Dict) -> None:
         field = STATE_FIELD.get(entity)
         for k, v in zip(d.keys, d.values):
-            if isinstance(k, ast.Constant) and k.value == field:
-                s = _norm_state(entity, v)
-                if s is not None or isinstance(v, ast.Constant):
-                    self._emit(node, entity, field,
-                               s if s is not None else repr(v.value),
-                               creation=True)
+            if not isinstance(k, ast.Constant):
+                continue
+            if k.value == field:
+                ment = entity
+            else:
+                ment = FIELD_MACHINES.get((entity, k.value))
+                if ment is None:
+                    continue
+            s = _norm_state(ment, v)
+            if s is not None or isinstance(v, ast.Constant):
+                self._emit(node, ment, k.value,
+                           s if s is not None else repr(v.value),
+                           creation=True)
 
     def visit_Assign(self, node: ast.Assign) -> None:
         self._learn_assign(node)
@@ -416,7 +453,9 @@ class _FuncExtractor(ast.NodeVisitor):
                 t.slice, ast.Constant
             ) and isinstance(t.slice.value, str):
                 ent = self._row_entity(t.value)
-                if ent is not None and t.slice.value == STATE_FIELD.get(ent):
+                if ent is not None and t.slice.value != STATE_FIELD.get(ent):
+                    ent = FIELD_MACHINES.get((ent, t.slice.value))
+                if ent is not None:
                     values = (
                         [node.value.body, node.value.orelse]
                         if isinstance(node.value, ast.IfExp)

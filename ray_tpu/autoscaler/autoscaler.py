@@ -93,15 +93,18 @@ class Autoscaler:
         node_types: List[NodeTypeConfig],
         idle_timeout_s: float = 5.0,
         update_interval_s: float = 0.5,
+        quarantine_replace_s: float = 30.0,
     ):
         self.gcs = RpcClient(gcs_addr[0], gcs_addr[1])
         self.provider = provider
         self.node_types = {nt.name: nt for nt in node_types}
         self.idle_timeout_s = idle_timeout_s
         self.update_interval_s = update_interval_s
+        self.quarantine_replace_s = quarantine_replace_s
         self.space = ResourceSpace()
         self._idle_since: Dict[str, float] = {}
         self._launched: Dict[str, str] = {}  # node_id -> type (incl. still-starting)
+        self._replaced: set = set()  # chronically-quarantined nodes already replaced
         self._stopped = False
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="autoscaler"
@@ -132,8 +135,32 @@ class Autoscaler:
 
     def update(self):
         state = self.gcs.call("autoscaler_state")
+        self._replace_chronic(state)
         self._scale_up(state)
         self._scale_down(state)
+
+    def _replace_chronic(self, state):
+        """Replace-don't-wait for gray failures: a node the GCS has kept
+        quarantined past ``quarantine_replace_s`` is treated like failed
+        hardware — launch a same-type replacement immediately and
+        terminate the sick node instead of waiting out probation."""
+        if self.quarantine_replace_s <= 0:
+            return
+        managed = set(self.provider.non_terminated_nodes())
+        self._replaced &= managed  # forget terminated nodes
+        for node_id, n in state.get("nodes", {}).items():
+            if node_id not in managed or not n.get("alive"):
+                continue
+            if not n.get("quarantined") or node_id in self._replaced or \
+                    n.get("quarantined_for", 0.0) < self.quarantine_replace_s:
+                continue
+            self._replaced.add(node_id)
+            t = n.get("labels", {}).get("node_type")
+            nt = self.node_types.get(t)
+            if nt is not None:
+                self._create(nt)
+            self.provider.terminate_node(node_id)
+            self._idle_since.pop(node_id, None)
 
     def _scale_up(self, state):
         from ray_tpu.autoscaler.instance_manager import pg_demand_classes

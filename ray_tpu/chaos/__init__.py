@@ -38,6 +38,7 @@ from ray_tpu.chaos.schedule import (  # noqa: F401 - public API
     partition,
     register_kill,
     reset,
+    slow,
     unregister_kill,
 )
 

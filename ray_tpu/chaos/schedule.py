@@ -28,11 +28,12 @@ import hashlib
 import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
-#: hook names, for reference: client_send | server_recv | server_send | step
-HOOKS = ("client_send", "server_recv", "server_send", "step")
+#: hook names, for reference: client_send | server_recv | server_send |
+#: step | exec (worker-side task-execution hook, see ``slow``)
+HOOKS = ("client_send", "server_recv", "server_send", "step", "exec")
 
 #: fault kinds a rule can inject
-KINDS = ("drop", "delay", "duplicate", "reset", "partition", "kill")
+KINDS = ("drop", "delay", "duplicate", "reset", "partition", "kill", "slow")
 
 # Process-level kill-target registry: harnesses (Cluster.add_node, soak
 # scripts) register targets HERE unconditionally, so a schedule installed
@@ -75,9 +76,18 @@ class Rule:
     until: Optional[int] = None
     delay_s: float = 0.05
     target: Optional[str] = None  # kill rules: registered kill-target name
+    # slow rules: execution-time multiplier injected at the worker exec
+    # hook (1.0 = no-op; float("inf") wedges the task forever — the
+    # gray-failure "alive but never finishes" mode)
+    factor: float = 1.0
 
     def matches(self, hook: str, src: str, dst: str,
                 method: Optional[str]) -> bool:
+        # exec consults pair exclusively with slow rules: a generic
+        # hook=None rule (e.g. drop(p=...)) must not fire on — or shadow —
+        # the worker execution stream, and vice versa
+        if (hook == "exec") != (self.kind == "slow"):
+            return False
         if self.hook is not None and self.hook != hook:
             return False
         if self.method is not None and self.method != method:
@@ -163,6 +173,19 @@ def kill(label: str = "*", p: float = 0.0, target: Optional[str] = None) -> Rule
     return Rule("kill", src=label, hook="step", p=p, target=target)
 
 
+def slow(node: str = "*", factor: float = 10.0, p: float = 1.0,
+         method: Optional[str] = None, frm: int = 0,
+         until: Optional[int] = None) -> Rule:
+    """Gray failure: multiply task execution time on matching nodes by
+    ``factor`` (consulted at the worker ``exec`` hook; ``method`` matches
+    the task's function name). ``factor=float("inf")`` wedges the task
+    forever — the node stays ALIVE on heartbeats while never finishing.
+    Default ``p=1.0``: a gray node is slow on *every* task, not
+    probabilistically."""
+    return Rule("slow", src=node, hook="exec", p=p, method=method,
+                frm=frm, until=until, factor=factor)
+
+
 # ------------------------------------------------------------ the schedule
 
 
@@ -195,6 +218,17 @@ class FaultSchedule:
     def on_server_send(self, src: str, dst: str,
                        channel: Optional[str]) -> Optional[Rule]:
         return self._consult("server_send", src, dst, channel)
+
+    def on_exec(self, node: str, method: Optional[str]) -> float:
+        """Worker-side task-execution hook: returns the execution-delay
+        factor for this task (1.0 = run at full speed). Consulted once per
+        task execution; the frame counter advances per (node, method)
+        stream, so decisions stay deterministic per stream like every
+        other hook. The first matching slow rule wins."""
+        rule = self._consult("exec", node, "*", method)
+        if rule is not None and rule.kind == "slow":
+            return float(rule.factor)
+        return 1.0
 
     def step(self, label: str) -> Optional[Rule]:
         """Process-level hook (test harness loops): consults kill rules.

@@ -1623,6 +1623,23 @@ class ClusterClient:
             n = self._nodes.get(node_id)
         return None if n is None else bool(n.get("alive", True))
 
+    def node_suspicion(self, node_id: str) -> float:
+        """Gray-failure suspicion score [0,1] of a node per this client's
+        pushed snapshot (no RPC; 0.0 when unknown). The serve fast-path
+        router folds this into its power-of-two choice so request share
+        decays away from ALIVE-but-DEGRADED replicas before the GCS ever
+        quarantines them."""
+        with self._lock:
+            n = self._nodes.get(node_id)
+        if n is None:
+            return 0.0
+        if n.get("quarantined"):
+            return 1.0
+        try:
+            return float(n.get("suspicion") or 0.0)
+        except (TypeError, ValueError):
+            return 0.0
+
     def dag_register(self, payload: dict) -> dict:
         return self.gcs.call("dag_register", payload, timeout=self._rpc_timeout)
 
@@ -1703,7 +1720,10 @@ class ClusterClient:
         raw = self.gcs.call("get_nodes", timeout=self._rpc_timeout)
         return [
             {"NodeID": nid, "Alive": n["alive"], "Resources": n["resources"],
-             "Labels": n.get("labels", {}), "Stats": n.get("stats") or {}}
+             "Labels": n.get("labels", {}), "Stats": n.get("stats") or {},
+             "Quarantined": bool(n.get("quarantined")),
+             "Health": n.get("health", "OK"),
+             "Suspicion": float(n.get("suspicion") or 0.0)}
             for nid, n in raw.items()
         ]
 

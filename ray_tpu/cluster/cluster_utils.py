@@ -107,7 +107,10 @@ class Cluster:
     def wait_for_nodes(self, n: int, timeout: float = 10.0):
         deadline = time.time() + timeout
         while time.time() < deadline:
-            alive = sum(1 for v in self.gcs.nodes.values() if v["alive"])
+            with self.gcs._lock:
+                alive = sum(
+                    1 for v in self.gcs.nodes.values() if v["alive"]
+                )
             if alive >= n:
                 return
             time.sleep(0.05)

@@ -72,6 +72,18 @@ _M_OVERLOADED = _metrics.Gauge(
     "derived cluster overload state (1 while the advisory throttle "
     "push is active)",
 )
+_M_QUARANTINED = _metrics.Gauge(
+    "ray_tpu_gcs_quarantined_nodes",
+    "nodes currently quarantined by the gray-failure defense plane",
+)
+_M_SPEC_LAUNCHED = _metrics.Counter(
+    "ray_tpu_gcs_speculative_launches_total",
+    "speculative straggler copies launched (gray-failure defense)",
+)
+_M_SPEC_WINS = _metrics.Counter(
+    "ray_tpu_gcs_speculative_wins_total",
+    "speculated tasks whose speculative copy finished first",
+)
 # per-method handler series keys, built once (see util/metrics.series_key)
 _HANDLER_KEYS: Dict[str, tuple] = {}
 
@@ -200,6 +212,33 @@ class GcsServer:
         # _overload_check) + last advisory-throttle broadcast time
         self._overloaded = False
         self._overload_last_push = 0.0
+
+        # --- gray-failure defense plane (README "Gray-failure defense") ---
+        # per-node health ledger: suspicion score in [0,1] folded from
+        # heartbeat inter-arrival jitter, daemon-reported queue load, and
+        # per-(func,node) duration EMAs vs the cluster-wide class EMA;
+        # hysteresis + sustain counters drive the OK -> SUSPECT ->
+        # QUARANTINED -> PROBATION -> OK lifecycle (mirrored into the node
+        # table's "health"/"suspicion" fields for clients/autoscaler)
+        self._health: Dict[str, dict] = {}
+        # quarantined nodes: generalizes _draining — the SAME scheduler
+        # mask (state.drain_node: nothing new lands, running work bleeds,
+        # releases still credit the row) but reversible via probe-verified
+        # recovery instead of terminate
+        self._quarantined: set = set()
+        self._quarantined_since: Dict[str, float] = {}
+        # per-class duration samples (bounded ring) for speculation p95s,
+        # plus per-(class, node) and per-(class, None)=cluster-wide EMAs
+        # feeding the suspicion slow component
+        self._dur_ring: Dict[str, deque] = {}
+        self._dur_ema: Dict[tuple, float] = {}
+        # losing executions of speculated tasks: (task_id, node_id) whose
+        # late terminal report must be a pure no-op (the winner already
+        # applied and every hold was released); bounded LRU like
+        # _taskdone_seen
+        self._spec_losers: OrderedDict = OrderedDict()
+        self._spec_launched = 0  # lifetime counter (tests/observability)
+        self._probe_seq = 0
 
         # --- scheduler state ---
         # intake: raw submissions, vetted once per round by _intake_locked
@@ -403,6 +442,11 @@ class GcsServer:
                 # a drain applies to one node INCARNATION: the fresh
                 # daemon process starts schedulable again
                 self._draining.discard(node_id)
+                # quarantine and the health ledger likewise judge one
+                # incarnation: the replacement daemon starts clean
+                self._quarantined.discard(node_id)
+                self._quarantined_since.pop(node_id, None)
+                self._health.pop(node_id, None)
             self.nodes[node_id] = {
                 "node_id": node_id,
                 "addr": p["addr"],
@@ -416,6 +460,12 @@ class GcsServer:
                 "instance": p.get("instance"),
                 "chan_dir": p.get("chan_dir"),
                 "draining": node_id in self._draining,
+                # gray-failure defense fields survive a connection bounce
+                # (same incarnation): the mask and ledger are keyed off
+                # _quarantined/_health, not this snapshot dict
+                "quarantined": node_id in self._quarantined,
+                "health": (self._health.get(node_id) or {}).get("state", "OK"),
+                "suspicion": (self._health.get(node_id) or {}).get("score", 0.0),
             }
             # recorded only after the entry commits (a malformed payload
             # must not leave an event for a node that never joined); rejoin
@@ -428,10 +478,11 @@ class GcsServer:
             revived = True
             if idx is None:
                 self.state.add_node(node_id, p["resources"], p.get("labels"))
-            elif node_id in self._draining:
-                # a draining row reads alive=False but its debits are
-                # live — a connection bounce must not revive (and reset)
-                # it out from under the running tasks bleeding off
+            elif node_id in self._draining or node_id in self._quarantined:
+                # a draining/quarantined row reads alive=False but its
+                # debits are live — a connection bounce must not revive
+                # (and reset) it out from under the running tasks
+                # bleeding off
                 revived = False
             elif not self.state.alive[idx]:
                 # re-registration after a death: revive the scheduler row
@@ -497,7 +548,9 @@ class GcsServer:
         with self._lock:
             n = self.nodes.get(p["node_id"])
             if n:
-                n["last_beat"] = self._rt.now()
+                now = self._rt.now()
+                self._beat_observed_locked(p["node_id"], n, now)
+                n["last_beat"] = now
                 if p.get("stats"):
                     # per-node physical stats (reporter-agent analog);
                     # served through get_nodes / the dashboard node table
@@ -528,7 +581,8 @@ class GcsServer:
             return {
                 nid: {k: n.get(k) for k in
                       ("addr", "port", "resources", "alive", "labels",
-                       "shm_name", "stats", "draining", "load")}
+                       "shm_name", "stats", "draining", "load",
+                       "quarantined", "health", "suspicion")}
                 for nid, n in self.nodes.items()
             }
 
@@ -581,6 +635,169 @@ class GcsServer:
             draining = node_id in self._draining
         self._kick()
         return {"ok": True, "running": running, "draining": draining}
+
+    # --- gray-failure defense plane (README "Gray-failure defense") ---
+
+    def _health_rec_locked(self, node_id: str) -> dict:
+        h = self._health.get(node_id)
+        if h is None:
+            h = self._health[node_id] = {
+                "state": "OK", "score": 0.0, "sustain": 0,
+                "clean_probes": 0, "last_probe": 0.0,
+            }
+        return h
+
+    def _beat_observed_locked(self, node_id: str, n: dict, now) -> None:
+        """Heartbeat inter-arrival tracking: EMA of the gap and of
+        |gap - EMA|. A daemon whose threads are CPU-starved beats
+        irregularly long before it misses the liveness timeout — the
+        jitter ratio is one of the three suspicion components."""
+        gap = now - n.get("last_beat", now)
+        if gap <= 0.0:
+            return
+        h = self._health_rec_locked(node_id)
+        ema = h.get("beat_ema")
+        if ema is None:
+            h["beat_ema"] = gap
+            h["beat_jit"] = 0.0
+        else:
+            h["beat_jit"] = 0.8 * h.get("beat_jit", 0.0) + 0.2 * abs(gap - ema)
+            h["beat_ema"] = 0.8 * ema + 0.2 * gap
+
+    def _enter_quarantine_locked(self, node_id: str, reason: str = "") -> None:
+        """Apply the reversible unschedulable mask: same drain mask the
+        autoscaler's graceful terminate uses (nothing new lands, running
+        work bleeds off, releases still credit the row), but the node is
+        expected BACK — probes drive the exit. Caller holds _lock."""
+        from ray_tpu.util.events import record_event
+
+        n = self.nodes.get(node_id)
+        if n is None or node_id in self._quarantined:
+            return
+        self._quarantined.add(node_id)
+        self._quarantined_since[node_id] = self._rt.now()
+        h = self._health_rec_locked(node_id)
+        h["state"] = "QUARANTINED"
+        h["clean_probes"] = 0
+        h["last_probe"] = 0.0
+        h["sustain"] = 0
+        n["quarantined"] = True
+        n["health"] = "QUARANTINED"
+        if n.get("alive") and node_id not in self._draining:
+            self.state.drain_node(node_id)
+        record_event(
+            "NODE_QUARANTINED",
+            f"node {node_id} quarantined: {reason or 'suspicion sustained'}",
+            severity="WARNING", source="gcs", node_id=node_id,
+        )
+        if rpc_mod.TRACE is not None:
+            rpc_mod.TRACE.apply(
+                "node_quarantine", node=node_id, quarantined=True,
+                reason=reason,
+            )
+
+    def _exit_quarantine_locked(self, node_id: str,
+                                reason: str = "") -> None:
+        """Reverse the mask into PROBATION: schedulable again but watched
+        — a relapse (score back over quarantine_high) re-quarantines
+        instantly, probation_sweeps clean sweeps restore OK. The node's
+        stale duration EMAs are dropped so the probation verdict comes
+        from post-recovery completions only. Caller holds _lock."""
+        from ray_tpu.util.events import record_event
+
+        if node_id not in self._quarantined:
+            return
+        self._quarantined.discard(node_id)
+        self._quarantined_since.pop(node_id, None)
+        h = self._health_rec_locked(node_id)
+        h["state"] = "PROBATION"
+        h["probation_left"] = self.config.probation_sweeps
+        h["sustain"] = 0
+        h["score"] = min(h.get("score", 0.0), self.config.quarantine_low / 2)
+        for k in [k for k in self._dur_ema if k[1] == node_id]:
+            del self._dur_ema[k]
+        n = self.nodes.get(node_id)
+        if n is not None:
+            n["quarantined"] = False
+            n["health"] = "PROBATION"
+            n["suspicion"] = h["score"]
+            if n.get("alive") and node_id not in self._draining:
+                self.state.undrain_node(node_id)
+        self._pg_retry_needed = True
+        record_event(
+            "NODE_UNQUARANTINED",
+            f"node {node_id} back on probation: {reason or 'recovered'}",
+            source="gcs", node_id=node_id,
+        )
+        if rpc_mod.TRACE is not None:
+            rpc_mod.TRACE.apply(
+                "node_quarantine", node=node_id, quarantined=False,
+                reason=reason,
+            )
+
+    def rpc_quarantine_node(self, p, conn):
+        """Manually (un)quarantine a node — the same reversible
+        unschedulable mask the gray-failure sweep applies automatically
+        on sustained suspicion. Unlike drain (a one-way ramp to
+        terminate), quarantine expects the node back: probes keep running
+        and recovery re-admits it via probation. Idempotent."""
+        with self._lock:
+            node_id = p["node_id"]
+            n = self.nodes.get(node_id)
+            if n is None:
+                return {"ok": False, "error": f"unknown node {node_id}"}
+            if p.get("unquarantine"):
+                self._exit_quarantine_locked(node_id, reason="manual")
+            else:
+                self._enter_quarantine_locked(node_id, reason="manual")
+            quarantined = node_id in self._quarantined
+            running = sum(
+                1 for info in self.running.values()
+                if info["node_id"] == node_id
+            )
+        self._kick()
+        return {"ok": True, "quarantined": quarantined, "running": running}
+
+    def rpc_probe_result(self, p, conn):
+        """From a quarantined node's daemon: one probe round-trip
+        finished. The probe exercises the chaos exec hook on the node, so
+        a still-gray node answers slowly — and a wedged one never answers
+        at all, which keeps quarantine sticky by construction. A healthy
+        probe decays suspicion; enough clean probes under quarantine_low
+        moves the node to PROBATION. A slow probe resets the progress."""
+        with self._lock:
+            node_id = p.get("node_id")
+            h = self._health.get(node_id)
+            if h is None or h.get("state") != "QUARANTINED":
+                return {"ok": True}  # stale probe from a past quarantine
+            # probe_id de-dupes retried/reordered reports (each counts
+            # once toward clean_probes); sent_at rejects answers to
+            # probes issued before THIS quarantine began — a slow answer
+            # from a prior epoch must not reset this epoch's progress
+            probe_id = int(p.get("probe_id") or 0)
+            if probe_id and probe_id <= h.get("probe_acked", 0):
+                return {"ok": True}
+            h["probe_acked"] = probe_id
+            sent_at = float(p.get("sent_at") or 0.0)
+            since = self._quarantined_since.get(node_id)
+            if sent_at and since is not None and sent_at < since:
+                return {"ok": True}
+            healthy = float(p.get("elapsed", 1e9)) < 0.25
+            if healthy:
+                h["clean_probes"] = h.get("clean_probes", 0) + 1
+                h["score"] = h.get("score", 1.0) * 0.6
+                n = self.nodes.get(node_id)
+                if n is not None:
+                    n["suspicion"] = h["score"]
+                if (h["clean_probes"] >= 2
+                        and h["score"] < self.config.quarantine_low):
+                    self._exit_quarantine_locked(node_id, reason="probe ok")
+            else:
+                h["clean_probes"] = 0
+                h["score"] = max(h.get("score", 0.0),
+                                 self.config.quarantine_high)
+        self._kick()
+        return {"ok": True}
 
     def rpc_register_driver(self, p, conn):
         with self._lock:
@@ -822,6 +1039,27 @@ class GcsServer:
         """From a node daemon: task finished. p: {task_id, node_id, status,
         results: [(oid, size)], inline: {oid: bytes}, error?, actor_id?}"""
         with self._lock:
+            # a cancelled speculative execution (or the cancelled primary
+            # of a speculation the copy won) reporting anyway: the winner
+            # already applied, released every hold, and owns the result
+            # directory — losing reports are pure no-ops beyond freeing
+            # the loser's locally-stored results
+            if (p.get("task_id"), p.get("node_id")) in self._spec_losers:
+                if rpc_mod.TRACE is not None:
+                    rpc_mod.TRACE.apply(
+                        "task_done_dup", task=p["task_id"], spec_loser=True,
+                    )
+                loser_oids = [oid for oid, _ in p.get("results", [])]
+            else:
+                loser_oids = None
+        if loser_oids is not None:
+            if loser_oids:
+                self._push_to_node(
+                    p["node_id"], "free_objects", {"object_ids": loser_oids}
+                )
+            return {"ok": True}
+        spec_cancels: List[str] = []
+        with self._lock:
             # Dedupe decision FIRST: the retry plane may resend an
             # already-applied report after an unanswered ack window, and
             # chaos can duplicate the frame outright. Everything below
@@ -849,6 +1087,12 @@ class GcsServer:
                     )
                 else:
                     rpc_mod.TRACE.apply("task_done_dup", task=p["task_id"])
+            if info is not None and info.get("spec"):
+                # speculated task: first terminal report wins — release
+                # every losing execution's hold, queue cancel pushes, and
+                # rewrite info to the winner so the release below credits
+                # the right row under the right ledger key
+                spec_cancels = self._resolve_speculation_locked(p, info)
             if info is not None:
                 if p.get("actor_creation") and p.get("status") == "FINISHED":
                     # alive actors hold their allocation for their lifetime
@@ -868,7 +1112,8 @@ class GcsServer:
                     self._pg_retry_needed = True
                     if rpc_mod.TRACE is not None:
                         rpc_mod.TRACE.apply(
-                            "release", key=p["task_id"],
+                            "release",
+                            key=info.get("ledger_key", p["task_id"]),
                             node=info["node_id"],
                         )
             stale_frees: List[str] = []
@@ -889,6 +1134,14 @@ class GcsServer:
                                            "name", "start", "end",
                                            "actor_id")}
                 )
+                # gray-failure defense: per-class duration stats (p95 ring
+                # for speculation triggers, per-(class,node) EMAs for the
+                # suspicion slow component). Actor calls are excluded —
+                # their durations reflect the actor's queue, not the node
+                if (info is not None and p.get("status") == "FINISHED"
+                        and not p.get("actor_creation")
+                        and not p.get("actor_id")):
+                    self._observe_duration_locked(p)
             cross_borrow_pushes = []
             task_owner_id = None
             if info is not None:
@@ -959,6 +1212,11 @@ class GcsServer:
                             info.get("meta", {}).get("retries_left", 0) > 0
                         a["state"] = "PENDING" if retryable else "DEAD"
             target = self._driver_conn(owner_conn, owner_id)
+        for nid in spec_cancels:
+            # kill/dequeue the losing execution (a wedged worker dies
+            # here); its eventual report is absorbed by the _spec_losers
+            # filter at the top of this handler
+            self._push_to_node(nid, "cancel_task", {"task_id": p["task_id"]})
         if stale_frees:
             self._push_to_node(
                 p["node_id"], "free_objects", {"object_ids": stale_frees}
@@ -978,6 +1236,99 @@ class GcsServer:
             self._push_conn(target, "task_result", p)
         self._kick()
         return {"ok": True}
+
+    def _resolve_speculation_locked(self, p: dict, info: dict) -> List[str]:
+        """First terminal report of a speculated task wins. Mark every
+        OTHER execution a loser (their late reports no-op via the
+        _spec_losers filter), release the losers' capacity holds under
+        their own ledger keys, and rewrite ``info`` to the winner's
+        (node, demand, ledger key) so the standard release in
+        rpc_task_done credits the right row. Caller holds _lock; returns
+        loser node ids for cancel_task pushes (sent after the lock
+        drops)."""
+        tid = p["task_id"]
+        reporting = p.get("node_id")
+        copies = info.pop("spec", [])
+        execs = [{"node_id": info["node_id"], "demand": info["demand"],
+                  "key": info.get("ledger_key", tid),
+                  "t0": info.get("t0")}] + copies
+        winner = next(
+            (e for e in execs if e["node_id"] == reporting), None
+        )
+        if winner is None:
+            # terminal report from a node hosting no execution of this
+            # task (cannot happen through the daemons; be conservative)
+            winner = execs[0]
+        losers = [e for e in execs if e is not winner]
+        name = (info.get("meta") or {}).get("name")
+        now = self._rt.now()
+        for e in losers:
+            self._spec_losers[(tid, e["node_id"])] = True
+            idx = self.state.node_index(e["node_id"])
+            if idx is not None:
+                self.state.release(idx, e["demand"])
+            # censored duration: the loser ran (now - t0) without
+            # finishing — a lower bound on its true runtime. Feed it to
+            # the per-(class, node) EMA ONLY (not the ring / cluster
+            # EMA, which must stay uncensored): without this, a node
+            # whose executions always lose the speculation race never
+            # accumulates the very slowness signal that should
+            # quarantine it.
+            t0 = e.get("t0")
+            if name and t0 is not None:
+                key = (name, e["node_id"])
+                dur = max(0.0, now - t0)
+                ema = self._dur_ema.get(key)
+                self._dur_ema[key] = (
+                    dur if ema is None else 0.7 * ema + 0.3 * dur
+                )
+            if rpc_mod.TRACE is not None:
+                rpc_mod.TRACE.apply(
+                    "spec_cancel", task=tid, node=e["node_id"], key=e["key"],
+                )
+                rpc_mod.TRACE.apply(
+                    "release", key=e["key"], node=e["node_id"],
+                )
+        while len(self._spec_losers) > 4096:
+            self._spec_losers.popitem(last=False)
+        if _metrics.ENABLED and winner["key"] != info.get("ledger_key", tid):
+            _M_SPEC_WINS.inc()
+        info["node_id"] = winner["node_id"]
+        info["demand"] = winner["demand"]
+        info["ledger_key"] = winner["key"]
+        return [e["node_id"] for e in losers]
+
+    def _observe_duration_locked(self, p: dict) -> None:
+        """Fold one finished execution's duration into the per-class p95
+        ring and the per-(class, node) / cluster-wide EMAs. Caller holds
+        _lock; called once per first_report (loser reports are filtered
+        before they get here)."""
+        name = p.get("name")
+        start, end = p.get("start"), p.get("end")
+        if not name or start is None or end is None:
+            return
+        dur = float(end) - float(start)
+        if dur < 0:
+            return
+        ring = self._dur_ring.get(name)
+        if ring is None:
+            ring = self._dur_ring[name] = deque(maxlen=128)
+        ring.append(dur)
+        for key in ((name, p.get("node_id")), (name, None)):
+            ema = self._dur_ema.get(key)
+            self._dur_ema[key] = (
+                dur if ema is None else 0.7 * ema + 0.3 * dur
+            )
+
+    def _class_p95_locked(self, name) -> Optional[float]:
+        """p95 of the recent duration ring for a task class, or None
+        until speculation_min_samples completions exist (an untrusted
+        quantile must not trigger speculation). Caller holds _lock."""
+        ring = self._dur_ring.get(name) if name else None
+        if ring is None or len(ring) < self.config.speculation_min_samples:
+            return None
+        s = sorted(ring)
+        return s[min(len(s) - 1, int(0.95 * len(s)))]
 
     def _credit_pg_locked(self, meta) -> None:
         """Return a finished bundle-riding task's debit to its bundle.
@@ -1475,6 +1826,15 @@ class GcsServer:
                     "alive": n["alive"],
                     "labels": n.get("labels", {}),
                     "running": running_per_node.get(nid, 0),
+                    # gray-failure defense: chronic quarantine is the
+                    # autoscaler's replace-don't-wait signal
+                    "quarantined": nid in self._quarantined,
+                    "health": n.get("health", "OK"),
+                    "suspicion": float(n.get("suspicion", 0.0) or 0.0),
+                    "quarantined_for": (
+                        self._rt.now() - self._quarantined_since[nid]
+                        if nid in self._quarantined_since else 0.0
+                    ),
                 }
             return {
                 "pending_demand": [
@@ -2022,6 +2382,14 @@ class GcsServer:
             cpu_i = self.space.index("CPU")
             cpus = 0.0
             if cpu_i is not None and len(self.state.alive):
+                # state.alive is False for draining AND quarantined rows
+                # (both ride the drain mask), so a gray node's CPUs never
+                # inflate the denominator: quarantining k nodes tightens
+                # the overload threshold for the survivors instead of
+                # silently raising it. The queued numerator above still
+                # counts the quarantined nodes' bleeding backlog — that
+                # work lands on the survivors via speculation/retry, so
+                # it DOES contend for the healthy pool.
                 cpus = float(
                     self.state.total[self.state.alive, cpu_i].sum()
                 )
@@ -2183,6 +2551,10 @@ class GcsServer:
                     "demand": demand,
                     "owner_conn": t["owner_conn"],
                     "meta": t,
+                    # dispatch timestamp: straggler detection compares
+                    # elapsed (incl. daemon queue wait) against the class
+                    # p95 — a wedged node's queue is part of its grayness
+                    "t0": self._rt.now(),
                 }
                 if rpc_mod.TRACE is not None:
                     rpc_mod.TRACE.apply(
@@ -2477,6 +2849,260 @@ class GcsServer:
                     dead.append(nid)
         for nid in dead:
             self._mark_node_dead(nid, "heartbeat timeout")
+        self._gray_sweep(now)
+
+    def _gray_sweep(self, now):
+        """Gray-failure defense sweep, one pass per health tick: refresh
+        per-node suspicion scores, walk the OK -> SUSPECT -> QUARANTINED
+        -> PROBATION lifecycle, probe quarantined nodes, and launch
+        speculative copies of stragglers. Scoring always runs (the
+        suspicion field is observability); gray_defense_enabled gates the
+        ACTIONS so the A/B storm can compare defended vs undefended arms
+        on the same trace."""
+        cfg = self.config
+        probes: List[tuple] = []
+        spec_pushes: List[tuple] = []
+        changed = False
+        with self._lock:
+            if not self.nodes:
+                return
+            overdue = self._overdue_by_node_locked(now)
+            for nid, n in self.nodes.items():
+                if not n.get("alive"):
+                    continue
+                h = self._health_rec_locked(nid)
+                st = h.get("state", "OK")
+                if st == "QUARANTINED":
+                    # completion EMAs starve under the mask; the score is
+                    # probe-driven until the node earns its way out
+                    score = h.get("score", 1.0)
+                else:
+                    score = self._suspicion_locked(nid, n, h, overdue)
+                    h["score"] = score
+                if abs(n.get("suspicion", 0.0) - score) > 0.05:
+                    changed = True
+                n["suspicion"] = score
+                if not cfg.gray_defense_enabled:
+                    continue
+                if st == "OK":
+                    if score >= cfg.quarantine_high:
+                        h["state"] = n["health"] = "SUSPECT"
+                        h["sustain"] = 1
+                        changed = True
+                elif st == "SUSPECT":
+                    if score >= cfg.quarantine_high:
+                        h["sustain"] = h.get("sustain", 0) + 1
+                        if h["sustain"] >= cfg.quarantine_sustain_sweeps:
+                            self._enter_quarantine_locked(
+                                nid,
+                                reason=f"suspicion {score:.2f} sustained "
+                                       f"{h['sustain']} sweeps",
+                            )
+                            changed = True
+                    elif score < cfg.quarantine_low:
+                        h["state"] = n["health"] = "OK"
+                        h["sustain"] = 0
+                        changed = True
+                elif st == "QUARANTINED":
+                    if cfg.probe_interval_s > 0 and \
+                            now - h.get("last_probe", 0.0) >= \
+                            cfg.probe_interval_s:
+                        h["last_probe"] = now
+                        self._probe_seq += 1
+                        probes.append((nid, {
+                            "probe_id": self._probe_seq, "sent_at": now,
+                        }))
+                elif st == "PROBATION":
+                    if score >= cfg.quarantine_high:
+                        # relapse: straight back, no sustain grace
+                        self._enter_quarantine_locked(
+                            nid, reason=f"probation relapse ({score:.2f})"
+                        )
+                        changed = True
+                    else:
+                        left = h.get(
+                            "probation_left", cfg.probation_sweeps
+                        ) - 1
+                        h["probation_left"] = left
+                        if left <= 0:
+                            h["state"] = n["health"] = "OK"
+                            h["sustain"] = 0
+                            changed = True
+            if cfg.gray_defense_enabled and \
+                    cfg.speculation_quantile_factor > 0:
+                spec_pushes = self._speculate_locked(now)
+            if _metrics.ENABLED:
+                _M_QUARANTINED.set(float(len(self._quarantined)))
+            if changed or spec_pushes:
+                self._publish_nodes()
+        for nid, payload in probes:
+            self._push_to_node(nid, "probe", payload)
+        for nid, ts in spec_pushes:
+            self._push_to_node(nid, "exec_tasks", ts)
+        if changed or spec_pushes:
+            self._kick()
+
+    def _suspicion_locked(self, nid: str, n: dict, h: dict,
+                          overdue: Dict[str, float]) -> float:
+        """Fold the three gray signals into one score in [0, 1]:
+
+        - slow: worst per-class duration EMA on this node relative to the
+          cluster-wide class EMA, plus overdue RUNNING work (elapsed vs
+          class p95 — a wedged task never completes, so completion EMAs
+          alone would never implicate its node);
+        - jitter: heartbeat inter-arrival deviation vs its own EMA;
+        - load: daemon-reported queue depth per worker vs cluster mean.
+
+        Weighted so a fully-slow node reaches quarantine_high on the slow
+        signal alone. Caller holds _lock."""
+        slow = 0.0
+        for (name, node), ema in self._dur_ema.items():
+            if node != nid:
+                continue
+            ref = self._dur_ema.get((name, None))
+            if not ref or ref <= 0:
+                continue
+            slow = max(slow, min(1.0, (ema / ref - 1.0) / 3.0))
+        slow = max(slow, overdue.get(nid, 0.0))
+        jit = 0.0
+        beat_ema = h.get("beat_ema") or 0.0
+        if beat_ema > 0:
+            jit = min(1.0, max(
+                0.0, h.get("beat_jit", 0.0) / beat_ema - 0.25
+            ) / 0.75)
+        load = 0.0
+        ld = n.get("load") or {}
+        q_node = float(ld.get("queued", 0)) / max(
+            1, int(ld.get("workers", 1) or 1)
+        )
+        total_q, total_n = 0.0, 0
+        for other in self.nodes.values():
+            if not other.get("alive"):
+                continue
+            od = other.get("load") or {}
+            total_q += float(od.get("queued", 0)) / max(
+                1, int(od.get("workers", 1) or 1)
+            )
+            total_n += 1
+        mean_q = total_q / max(1, total_n)
+        if q_node > 2.0 * mean_q + 1.0:
+            load = min(
+                1.0, (q_node - 2.0 * mean_q) / (4.0 * max(mean_q, 1.0))
+            )
+        return min(1.0, 0.75 * slow + 0.2 * jit + 0.1 * load)
+
+    def _overdue_by_node_locked(self, now) -> Dict[str, float]:
+        """node -> [0,1] slowness from RUNNING executions' elapsed time vs
+        factor*p95 of their class. This is the signal path for tasks that
+        never finish (chaos ``slow`` with factor=inf): their node's
+        completion EMAs stay silent, but elapsed keeps growing. Caller
+        holds _lock."""
+        out: Dict[str, float] = {}
+        k = max(1.0, self.config.speculation_quantile_factor)
+        floor_s = self.config.speculation_min_elapsed_s
+        for tid, info in self.running.items():
+            if tid.startswith(("actor-hold-", "dag-hold-")):
+                continue
+            name = (info.get("meta") or {}).get("name")
+            p95 = self._class_p95_locked(name)
+            if p95 is None:
+                continue
+            bar = max(k * p95, floor_s, 1e-3)
+            for e in [info] + list(info.get("spec") or ()):
+                t0 = e.get("t0")
+                if t0 is None:
+                    continue
+                ratio = (now - t0) / bar
+                if ratio > 1.0:
+                    sc = min(1.0, (ratio - 1.0) / 2.0)
+                    if sc > out.get(e["node_id"], 0.0):
+                        out[e["node_id"]] = sc
+        return out
+
+    def _speculate_locked(self, now) -> List[tuple]:
+        """Launch speculative duplicates of stragglers: a RUNNING plain
+        func task whose elapsed time exceeds factor*p95 of its class gets
+        a copy on a measurably healthier node with capacity. The copy is
+        a NEW execution of the SAME task id — first terminal report wins
+        in rpc_task_done, losers are cancelled and their holds released.
+        Copies bypass the admission ledger (the primary already holds the
+        admit — zero extra admission events) and are stamped
+        ``speculative`` in the trace with their own ledger key so the
+        invariant checker can demand exactly-one winning apply and
+        cancel-conservation. Caller holds _lock; returns
+        [(node_id, [meta])] pushes to send after the lock drops."""
+        cfg = self.config
+        pushes: List[tuple] = []
+        for tid, info in self.running.items():
+            if tid.startswith(("actor-hold-", "dag-hold-")):
+                continue
+            meta = info.get("meta") or {}
+            strat_kind = (meta.get("strategy") or {}).get("kind")
+            if (meta.get("actor_creation") or meta.get("actor_id")
+                    or meta.get("pg_debit")
+                    or strat_kind not in (None, "DEFAULT", "SPREAD")):
+                continue  # only stateless placement-free funcs race safely
+            copies = info.get("spec") or []
+            if 1 + len(copies) >= cfg.speculation_max_copies:
+                continue
+            t0 = info.get("t0")
+            if t0 is None:
+                continue
+            p95 = self._class_p95_locked(meta.get("name"))
+            if p95 is None:
+                continue
+            if now - t0 <= max(
+                cfg.speculation_quantile_factor * p95,
+                cfg.speculation_min_elapsed_s,
+            ):
+                continue
+            target = self._spec_target_locked(info, copies)
+            if target is None:
+                continue
+            skey = f"{tid}~s{len(copies) + 1}"
+            info.setdefault("spec", []).append({
+                "node_id": target, "demand": info["demand"],
+                "key": skey, "t0": now,
+            })
+            self._spec_launched += 1
+            if _metrics.ENABLED:
+                _M_SPEC_LAUNCHED.inc()
+            if rpc_mod.TRACE is not None:
+                rpc_mod.TRACE.apply(
+                    "dispatch", task=tid, node=target,
+                    res=self.space.unvector(info["demand"]),
+                    speculative=True, key=skey,
+                )
+            pushes.append((target, [meta]))
+        return pushes
+
+    def _spec_target_locked(self, info: dict, copies: list) -> Optional[str]:
+        """Healthiest schedulable node with capacity for one more
+        execution of this task, excluding every node already hosting one.
+        Requires a node MEASURABLY healthier than the primary — two
+        equally-healthy nodes just mean the task class is heavy-tailed,
+        and duplicating it would burn capacity for nothing. Allocates the
+        hold on success. Caller holds _lock."""
+        exclude = {info["node_id"]} | {c["node_id"] for c in copies}
+        primary_susp = float(
+            self.nodes.get(info["node_id"], {}).get("suspicion", 0.0) or 0.0
+        )
+        cands = []
+        for nid, n in self.nodes.items():
+            if nid in exclude or not n.get("alive"):
+                continue
+            if nid in self._quarantined or nid in self._draining:
+                continue
+            susp = float(n.get("suspicion", 0.0) or 0.0)
+            if susp + 0.05 >= primary_susp:
+                continue
+            cands.append((susp, nid))
+        cands.sort()
+        for _susp, nid in cands:
+            idx = self.state.node_index(nid)
+            if idx is not None and self.state.allocate(idx, info["demand"]):
+                return nid
+        return None
 
     def _mark_node_dead(self, node_id: str, cause: str):
         """Reference: GcsNodeManager::OnNodeFailure — broadcast death, fail
@@ -2492,6 +3118,12 @@ class GcsServer:
                          node_id=node_id, cause=cause)
             n["alive"] = False
             self._draining.discard(node_id)  # a dead node needs no drain
+            # dead trumps gray: drop the quarantine mask and the health
+            # ledger with the row (a rejoin starts a fresh incarnation)
+            self._quarantined.discard(node_id)
+            self._quarantined_since.pop(node_id, None)
+            self._health.pop(node_id, None)
+            n["quarantined"] = False
             self.state.remove_node(node_id)
             # the node's serve fast-path pairs died with it: drop the
             # registrations (clients detect the death through their node
@@ -2504,6 +3136,42 @@ class GcsServer:
             self.metrics_agg.drop_source(node_id)
             if rpc_mod.TRACE is not None:
                 rpc_mod.TRACE.apply("node_dead", node=node_id, cause=cause)
+            # speculation vs node death: a dying PRIMARY with a surviving
+            # speculative copy PROMOTES the copy (the task keeps running,
+            # no owner-visible failure — that rescue is the point of
+            # speculating); a dying copy is simply dropped. Must run
+            # before lost_tasks is collected below.
+            for tid, info in list(self.running.items()):
+                copies = info.get("spec")
+                if not copies:
+                    continue
+                if info["node_id"] == node_id:
+                    c = copies.pop(0)
+                    if not copies:
+                        info.pop("spec", None)
+                    # a wedged-but-connected daemon marked dead by the
+                    # heartbeat timeout can still get a late report out
+                    self._spec_losers[(tid, node_id)] = True
+                    info["node_id"] = c["node_id"]
+                    info["demand"] = c["demand"]
+                    info["t0"] = c.get("t0", info.get("t0"))
+                    info["ledger_key"] = c["key"]
+                    if rpc_mod.TRACE is not None:
+                        rpc_mod.TRACE.apply(
+                            "spec_promote", task=tid, node=c["node_id"],
+                            key=c["key"],
+                        )
+                else:
+                    kept = [c for c in copies if c["node_id"] != node_id]
+                    if len(kept) != len(copies):
+                        # the dead node's copy (and its ledger entry) go
+                        # with the node_dead wipe; no release, no cancel
+                        if kept:
+                            info["spec"] = kept
+                        else:
+                            info.pop("spec", None)
+            while len(self._spec_losers) > 4096:
+                self._spec_losers.popitem(last=False)
             lost_tasks = [
                 (tid, info) for tid, info in self.running.items()
                 if info["node_id"] == node_id
@@ -2710,9 +3378,13 @@ class GcsServer:
         self._kick()
 
     def _publish_nodes(self):
+        # suspicion/health/quarantined ride the snapshot so clients (and
+        # the serve fast-path router's pow-2 choice) can weight replicas
+        # away from gray nodes without any extra RPC
         snapshot = {
             nid: {k: n.get(k) for k in
-                  ("addr", "port", "resources", "alive", "shm_name")}
+                  ("addr", "port", "resources", "alive", "shm_name",
+                   "suspicion", "health", "quarantined", "draining")}
             for nid, n in self.nodes.items()
         }
         self.server.broadcast("nodes", snapshot)

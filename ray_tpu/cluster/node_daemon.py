@@ -356,6 +356,8 @@ class NodeDaemon:
         )
         self.gcs.subscribe("exec_task", self._on_exec_task)
         self.gcs.subscribe("exec_tasks", self._on_exec_tasks)
+        self.gcs.subscribe("cancel_task", self._on_cancel_task)
+        self.gcs.subscribe("probe", self._on_probe)
         self.gcs.subscribe("kill_actor", self._on_kill_actor)
         self.gcs.subscribe(
             "free_objects", lambda p: self.store.delete(p["object_ids"])
@@ -934,6 +936,71 @@ class NodeDaemon:
             self.server.call_soon(
                 lambda c=conn, task=t: asyncio.ensure_future(c.push("run_task", task))
             )
+
+    def _on_cancel_task(self, p: dict):
+        """GCS push: a speculative race for this task was decided elsewhere
+        (or the copy here lost) — stop burning capacity on it. Queued: the
+        task is silently dropped (the GCS already released this node's
+        hold and treats the execution as cancelled). Running: the worker is
+        killed — on a gray node it is likely wedged, and in-process task
+        preemption doesn't exist; the resulting WORKER_DIED report is
+        dropped by the GCS's loser filter."""
+        tid = p.get("task_id")
+        with self._prefetch_cv:
+            for item in list(self._prefetch_queue):
+                if item[0].get("task_id") == tid:
+                    self._prefetch_queue.remove(item)
+                    return
+        victim = None
+        with self._lock:
+            for t in list(self._task_queue):
+                if t.get("task_id") == tid:
+                    self._task_queue.remove(t)
+                    return
+            for w in self.workers.values():
+                t = w.current_task
+                if (
+                    t is not None and t.get("task_id") == tid
+                    and not w.actor_id
+                ):
+                    victim = w
+                    break
+        if victim is not None:
+            try:
+                victim.proc.kill()
+            except Exception:  # noqa: BLE001 - already exiting
+                pass
+
+    def _on_probe(self, p: dict):
+        """GCS push while this node is quarantined: run a tiny probe
+        execution off-thread and report how long it took. The chaos exec
+        hook is consulted so an injected gray node answers slowly — and a
+        wedged (factor=inf) one never answers — probes must experience
+        what real tasks experience, or recovery verification would lie.
+        Off-thread because a slow probe must not stall the push loop."""
+        def run():
+            t0 = time.time()
+            ch = rpc_mod.CHAOS
+            if ch is not None:
+                factor = ch.on_exec(self.node_id, "__probe__")
+                if factor == float("inf"):
+                    return  # wedged: quarantine stays sticky
+                if factor > 1.0:
+                    # emulate a 50ms-equivalent task under the slow factor
+                    time.sleep(min((factor - 1.0) * 0.05, 600.0))
+            try:
+                self.gcs.call_async("probe_result", {
+                    "node_id": self.node_id,
+                    "probe_id": p.get("probe_id"),
+                    "sent_at": p.get("sent_at"),
+                    "elapsed": time.time() - t0,
+                })
+            except Exception:  # noqa: BLE001 - daemon may be shutting down
+                pass
+
+        threading.Thread(
+            target=run, daemon=True, name=f"probe-{self.node_id[:8]}"
+        ).start()
 
     def _dispatch_actor_task(self, t: dict):
         aid = t["actor_id"]

@@ -272,6 +272,32 @@ def _finish_value(client, t, value, num_returns, aio):
     return [value] if num_returns == 1 else list(value)
 
 
+def _chaos_exec_stall(t: dict, start: float) -> None:
+    """Chaos ``slow`` hook (gray-failure injection): stretch this task's
+    apparent execution time by the schedule's factor. The stall happens
+    BEFORE the result report, so an inf-factor task is indistinguishable
+    from a wedged worker — the node keeps heartbeating, the task never
+    finishes. Zero overhead when no schedule is installed (one module
+    global check, same contract as the RPC hooks)."""
+    from ray_tpu.cluster import rpc as rpc_mod
+
+    ch = rpc_mod.CHAOS
+    if ch is None:
+        return
+    factor = ch.on_exec(
+        os.environ.get("RAY_TPU_NODE_ID", "*"), t.get("name")
+    )
+    if factor <= 1.0:
+        return
+    if factor == float("inf"):
+        while True:  # wedged forever; only process death ends it
+            time.sleep(1.0)
+    # multiplicative over real elapsed time, with a small floor so a gray
+    # node is visibly slow even on sub-millisecond tasks
+    elapsed = max(time.time() - start, 0.02)
+    time.sleep(min(elapsed * (factor - 1.0), 600.0))
+
+
 def _execute(client: RpcClient, t: dict):
     task_id = t["task_id"]
     start = time.time()
@@ -370,6 +396,7 @@ def _execute(client: RpcClient, t: dict):
         # the frame still binds whatever the try block reached; clear so
         # arg refs aren't miscounted as stashed below
         spec = args = kwargs = values = None
+    _chaos_exec_stall(t, start)
     borrows = _collect_borrows(task_arg_refs) if task_arg_refs else []
     # Results go straight into shm (create+seal, zero daemon copies); the
     # RPC carries only (oid, size). Fallback: bytes in the RPC frame.

@@ -118,6 +118,37 @@ _DEFS: Dict[str, tuple] = {
     # when queued tasks exceed high*total_CPUs, cleared below low*CPUs
     "overload_pending_high_per_cpu": (float, 8.0),
     "overload_pending_low_per_cpu": (float, 2.0),
+    # --- gray-failure defense plane (health scoring + straggler
+    # speculation + quarantine; see README "Gray-failure defense") ---
+    # master switch for the whole plane (scoring always runs; this gates
+    # speculation + quarantine ACTIONS so the A/B storm can compare arms)
+    "gray_defense_enabled": (bool, True),
+    # straggler speculation: a RUNNING task whose elapsed time exceeds
+    # factor * p95(its class's observed durations) gets a speculative
+    # duplicate on a healthier node; 0 disables speculation
+    "speculation_quantile_factor": (float, 3.0),
+    # total executions per task including the primary (2 = at most one
+    # speculative copy)
+    "speculation_max_copies": (int, 2),
+    # duration samples a class needs before its p95 is trusted
+    "speculation_min_samples": (int, 5),
+    # elapsed-time floor before any task is speculation-eligible (guards
+    # sub-millisecond classes against scheduler-jitter false positives)
+    "speculation_min_elapsed_s": (float, 0.2),
+    # node suspicion hysteresis (score in [0,1] from heartbeat jitter +
+    # per-(func,node) duration EMAs): sustained >= high quarantines,
+    # probe-verified < low returns the node to service via probation
+    "quarantine_high": (float, 0.7),
+    "quarantine_low": (float, 0.3),
+    # consecutive health sweeps over quarantine_high before quarantine
+    # actually triggers ("sustained", not a single bad sample)
+    "quarantine_sustain_sweeps": (int, 3),
+    # cadence of probe pushes to quarantined nodes (probe results feed
+    # recovery; 0 disables probing, leaving quarantine sticky)
+    "probe_interval_s": (float, 2.0),
+    # health sweeps a PROBATION node must stay clean before full OK;
+    # a relapse (score >= high) during probation re-quarantines instantly
+    "probation_sweeps": (int, 3),
     "num_workers_soft_limit": (int, 0),  # 0 -> num_cpus
     "worker_start_timeout_s": (float, 30.0),
     "metrics_report_interval_ms": (float, 2000.0),

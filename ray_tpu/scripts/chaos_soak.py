@@ -71,6 +71,16 @@ ap.add_argument("--race", action="store_true",
                      "lock/thread/queue/executor edge vector-clocked; "
                      "EXITS 1 on any detected race, with both access "
                      "stacks in a race-*.jsonl artifact")
+ap.add_argument("--gray", action="store_true",
+                help="mix seeded gray failures into the fault plane: a "
+                     "probabilistic chaos ``slow`` rule stretches task "
+                     "executions 12x on whatever node they land on, with "
+                     "the gray defense plane armed fast (250ms sweeps, "
+                     "2-sweep quarantine sustain, 0.5s probes) — "
+                     "exercising suspicion scoring, speculation, and the "
+                     "quarantine/probation lifecycle under node churn; "
+                     "slowed tasks still terminally resolve, so the 0-"
+                     "errors gate is unchanged")
 ap.add_argument("--serve", action="store_true",
                 help="mix serve fast-path deployments into the workload: "
                      "bursts of channel-plane requests against "
@@ -123,29 +133,45 @@ rpc_budget = _rpcflow.load_budget(
     os.path.join(_rpcflow.repo_root(), _rpcflow.DEFAULT_BUDGET_FILE))
 
 rng = random.Random(args.seed)  # workload mix (tasks vs actors vs PGs)
-sched = chaos.install(chaos.FaultSchedule(seed=args.seed, rules=[
+_rules = [
     # ~1 node kill per 25 loop iterations, deterministic per seed
     chaos.kill(label="soak", p=0.04, target="churn"),
     # occasional driver->GCS resets exercise the reconnect plane
     chaos.reset(src="driver-*", dst="gcs", p=0.002, hook="client_send"),
     # lossy daemon->GCS link exercises call retries
     chaos.drop(src="node-*", dst="gcs", p=0.001, hook="client_send"),
-]))
+]
+if args.gray:
+    # seeded gray failures: ~3% of executions run 12x slow, anywhere —
+    # enough to light up suspicion/speculation/probation without wedging
+    # any task past its get() timeout (0.02s * 12 << 60s)
+    _rules.append(chaos.slow(node="*", factor=12.0, p=0.03))
+sched = chaos.install(chaos.FaultSchedule(seed=args.seed, rules=_rules))
 
 _overrides = {}
+if args.gray:
+    # arm the defense plane fast so the short soak actually cycles the
+    # quarantine/probation lifecycle (the probe path is also chaos-slowed
+    # by the same rule, so sticky quarantine gets exercised too)
+    _overrides.update({
+        "health_check_period_ms": 250.0,
+        "quarantine_sustain_sweeps": 2,
+        "probe_interval_s": 0.5,
+        "speculation_min_elapsed_s": 0.15,
+    })
 if args.bursty:
     # arm the overload control plane so the burst mix exercises it: a
     # small per-driver admission bound, fast pacing, and low throttle
     # thresholds (the soak gate still requires 0 task errors — typed
     # overload rejections are budgeted separately below)
-    _overrides = {
+    _overrides.update({
         "admission_max_pending_per_driver": 48,
         "admission_retry_after_s": 0.1,
         "admission_pacing_enabled": True,
         "admission_pacing_max_s": 60.0,
         "overload_pending_high_per_cpu": 6.0,
         "overload_pending_low_per_cpu": 2.0,
-    }
+    })
 from ray_tpu.core.config import Config as _Config
 
 cluster = Cluster(config=_Config(dict(_overrides)))
@@ -395,7 +421,9 @@ print("\n".join(
     ln for ln in _prom.splitlines()
     if ln.startswith(("ray_tpu_rpc_reconnects", "ray_tpu_rpc_resends",
                       "ray_tpu_rpc_blackhole", "ray_tpu_gcs_sched_round_s_c",
-                      "ray_tpu_client_tasks_submitted"))
+                      "ray_tpu_client_tasks_submitted",
+                      "ray_tpu_gcs_quarantined_nodes",
+                      "ray_tpu_gcs_speculative"))
 ), flush=True)
 
 ray_tpu.shutdown(); cluster.shutdown(); chaos.uninstall()

@@ -140,6 +140,15 @@ def main(argv=None) -> int:
                          "drops, the goodput ratio/fraction bars, and a "
                          "clean strict-terminal invariant check "
                          "(artifact: overload_storm.json)")
+    ap.add_argument("--gray-storm", action="store_true",
+                    help="also run the gray-failure defense storm in "
+                         "SMOKE mode (scripts/gray_storm.py --smoke): "
+                         "2-of-5 nodes chaos-slowed 25x, A/B over the "
+                         "defense plane, gated on the p99/goodput "
+                         "recovery bars, the wedged-gang speculation "
+                         "rescue, quarantine engagement, and a clean "
+                         "strict-terminal invariant check "
+                         "(artifact: gray_storm.json)")
     ap.add_argument("--tier1", action="store_true",
                     help="also run the tier-1 suite with --durations=25 "
                          "and save the output as an artifact")
@@ -511,6 +520,26 @@ def main(argv=None) -> int:
             sys.stderr.write(proc.stderr[-2000:])
             return 1
         print(f"overload_storm: gate green (artifact: {art})")
+
+    # (4e) gray-failure defense storm smoke: the tail-latency-recovery
+    # gate (quarantine engages, speculation rescues the wedged gang,
+    # zero duplicate task_done applies in the strict-terminal trace)
+    if args.gray_storm:
+        art = os.path.join(args.artifact_dir, "gray_storm.json")
+        proc = subprocess.run(
+            [sys.executable, "-m", "ray_tpu.scripts.gray_storm",
+             "--smoke", "--json", art],
+            cwd=REPO, capture_output=True, text=True,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        )
+        sys.stdout.write(proc.stdout)
+        if proc.returncode != 0:
+            print("lint_gate: gray storm gate RED (tail latency not "
+                  "recovered, wedge not rescued, or invariant "
+                  "violation)", file=sys.stderr)
+            sys.stderr.write(proc.stderr[-2000:])
+            return 1
+        print(f"gray_storm: gate green (artifact: {art})")
 
     # (5) tier-1 with per-test durations as a CI artifact. The pytest
     # process writes a final metrics snapshot at exit (util/metrics.py

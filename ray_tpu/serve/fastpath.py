@@ -363,7 +363,17 @@ class FastPathRouter:
             pa, pb = self._pairs.get(a), self._pairs.get(b)
             la = pa.inflight if pa is not None else 0
             lb = pb.inflight if pb is not None else 0
-            return (a if la <= lb else b), None
+        # health-weighted pow-2 (gray-failure defense): scale each
+        # candidate's observed load by its node's suspicion from the
+        # pushed node snapshot (a local dict read — the request path
+        # stays RPC-free). A replica on an ALIVE-but-DEGRADED node loses
+        # ties immediately and loses outright as suspicion grows, so its
+        # request share decays long before the GCS quarantines it.
+        sa = self._node_suspicion(pa.node_id) if pa is not None else 0.0
+        sb = self._node_suspicion(pb.node_id) if pb is not None else 0.0
+        wa = (la + 1.0) * (1.0 + 4.0 * sa)
+        wb = (lb + 1.0) * (1.0 + 4.0 * sb)
+        return (a if wa <= wb else b), None
 
     def _ensure_pair(self, actor_id: str) -> _Pair:
         """Get or build the channel pair for one replica. The build is the
@@ -682,6 +692,15 @@ class FastPathRouter:
         if alive is None:
             return None
         return alive(node_id)
+
+    def _node_suspicion(self, node_id: str) -> float:
+        susp = getattr(self._rt, "node_suspicion", None)
+        if susp is None or node_id is None:
+            return 0.0
+        try:
+            return float(susp(node_id) or 0.0)
+        except Exception:  # noqa: BLE001 - routing must never raise here
+            return 0.0
 
     # ------------------------------------------------------------- failure
 

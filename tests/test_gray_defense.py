@@ -1,0 +1,459 @@
+"""Gray-failure defense plane (node health scoring, straggler
+speculation, quarantine/probation) — ISSUE-17.
+
+Unit tests drive the scoring/overdue math on a stub GCS; lifecycle
+tests drive ``_gray_sweep`` deterministically on a live cluster with
+the background sweep parked; the wedge-forever test is the headline
+rescue — a chaos ``slow`` rule with factor=inf wedges a live node's
+executions forever (the node stays ALIVE on heartbeats, so retries
+never fire) and straggler speculation must finish the job anyway,
+under BOTH dynamic sanitizers."""
+
+import json
+import random
+import threading
+import time
+import types
+from collections import deque
+from types import SimpleNamespace
+
+import pytest
+
+import ray_tpu
+from ray_tpu import chaos
+from ray_tpu.chaos import FaultSchedule
+from ray_tpu.cluster.cluster_utils import Cluster
+from ray_tpu.cluster.gcs import GcsServer
+from ray_tpu.core.config import Config
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_chaos():
+    yield
+    chaos.uninstall()
+
+
+# ================================================= scoring (unit, stub GCS)
+
+
+def test_suspicion_score_components():
+    """The three gray signals fold with 0.75/0.2/0.1 weights; the slow
+    term alone must be able to clear quarantine_high (0.7)."""
+    n = {"alive": True}
+    ns = SimpleNamespace(_dur_ema={}, nodes={"a": n})
+    assert GcsServer._suspicion_locked(ns, "a", n, {}, {}) == 0.0
+
+    # completions 4x the cluster-wide class EMA saturate the slow term
+    ns._dur_ema = {("f", "a"): 4.0, ("f", None): 1.0}
+    assert GcsServer._suspicion_locked(ns, "a", n, {}, {}) == \
+        pytest.approx(0.75)
+
+    # overdue RUNNING work implicates a node with NO completions at all
+    # (the wedged-forever case: its completion EMAs stay silent)
+    ns._dur_ema = {}
+    assert GcsServer._suspicion_locked(ns, "a", n, {}, {"a": 1.0}) == \
+        pytest.approx(0.75)
+
+    # heartbeat jitter at 1x its own gap EMA maxes the 0.2-weight term
+    h = {"beat_ema": 1.0, "beat_jit": 1.0}
+    assert GcsServer._suspicion_locked(ns, "a", n, h, {"a": 1.0}) == \
+        pytest.approx(0.95)
+
+    # load: queue-per-worker way above the cluster mean adds the 0.1 term
+    loaded = {"alive": True, "load": {"queued": 10, "workers": 1}}
+    ns2 = SimpleNamespace(_dur_ema={}, nodes={
+        "a": loaded,
+        **{f"peer{i}": {"alive": True, "load": {"queued": 0, "workers": 1}}
+           for i in range(4)},
+    })
+    s = GcsServer._suspicion_locked(ns2, "a", loaded, {}, {})
+    assert s == pytest.approx(0.1 * 0.75)
+
+    # all three saturated: clipped into [0, 1]
+    ns._dur_ema = {("f", "a"): 40.0, ("f", None): 1.0}
+    h = {"beat_ema": 1.0, "beat_jit": 5.0}
+    assert GcsServer._suspicion_locked(ns, "a", n, h, {"a": 1.0}) <= 1.0
+
+
+def test_overdue_signal_from_running_elapsed():
+    """A RUNNING execution far past factor*p95 of its class scores its
+    node — primary and speculative copies alike; classes without enough
+    ring samples contribute nothing."""
+    cfg = Config({"speculation_quantile_factor": 3.0,
+                  "speculation_min_elapsed_s": 0.1,
+                  "speculation_min_samples": 2})
+    ns = SimpleNamespace(config=cfg, running={},
+                         _dur_ring={"f": deque([0.05] * 4)})
+    ns._class_p95_locked = types.MethodType(GcsServer._class_p95_locked, ns)
+    now = 100.0
+    assert GcsServer._overdue_by_node_locked(ns, now) == {}
+
+    ns.running = {
+        # primary ~6.7 bars overdue (bar = max(3*p95, floor) = 0.15):
+        # saturates; its healthy spec copy (fresh t0) does not score
+        "t1": {"node_id": "bad", "t0": now - 1.0, "demand": None,
+               "meta": {"name": "f"},
+               "spec": [{"node_id": "ok", "t0": now - 0.05}]},
+        # class with a starved ring (< min_samples): no p95, no signal
+        "t2": {"node_id": "bad2", "t0": now - 9.0,
+               "meta": {"name": "unknown-class"}},
+        # actor holds never count as overdue work
+        "actor-hold-x": {"node_id": "bad", "t0": now - 50.0, "meta": {}},
+    }
+    out = GcsServer._overdue_by_node_locked(ns, now)
+    assert out == {"bad": 1.0}
+
+    # just past the bar: proportional, not binary
+    ns.running = {"t1": {"node_id": "b", "t0": now - 0.30,
+                         "meta": {"name": "f"}}}
+    out = GcsServer._overdue_by_node_locked(ns, now)
+    assert 0.0 < out["b"] < 1.0
+
+
+# ====================================== quarantine/probation lifecycle
+
+
+def _lifecycle_overrides():
+    return {
+        # park the background sweep: the test drives _gray_sweep itself
+        "health_check_period_ms": 3_600_000.0,
+        "quarantine_sustain_sweeps": 2,
+        "probation_sweeps": 2,
+        "probe_interval_s": 0.0,  # probe results injected directly
+        "gray_defense_enabled": True,
+        "log_to_driver": False,
+    }
+
+
+def _seed_slow(srv, node_id):
+    with srv._lock:
+        srv._dur_ema[("f", node_id)] = 4.0
+        srv._dur_ema[("f", None)] = 1.0
+
+
+def test_quarantine_probation_lifecycle():
+    """OK -> SUSPECT -> (sustain) -> QUARANTINED -> (clean probes) ->
+    PROBATION -> relapse -> QUARANTINED -> probes -> PROBATION ->
+    (clean sweeps) -> OK, with the reversible drain mask tracking every
+    transition."""
+    cluster = Cluster(config=Config(_lifecycle_overrides()))
+    cluster.add_node(num_cpus=2, node_id="lc-a")
+    cluster.add_node(num_cpus=2, node_id="lc-b")
+    cluster.wait_for_nodes(2)
+    srv = cluster.gcs
+    try:
+        _seed_slow(srv, "lc-b")
+        now = time.time()
+        srv._gray_sweep(now)
+        assert srv.nodes["lc-b"]["health"] == "SUSPECT"
+        assert srv.nodes["lc-a"]["health"] == "OK"
+        assert "lc-b" not in srv._quarantined  # sustain window not met
+
+        srv._gray_sweep(now + 1)  # sustain 2 >= quarantine_sustain_sweeps
+        assert srv.nodes["lc-b"]["health"] == "QUARANTINED"
+        assert srv.nodes["lc-b"]["quarantined"] is True
+        assert "lc-b" in srv._quarantined
+        # the reversible mask: row unschedulable but the node is ALIVE
+        assert not bool(srv.state.alive[srv.state.node_index("lc-b")])
+        assert srv.nodes["lc-b"]["alive"]
+
+        # quarantined score is probe-driven: sweeps alone never exit
+        srv._gray_sweep(now + 2)
+        assert srv.nodes["lc-b"]["health"] == "QUARANTINED"
+
+        # one clean probe decays the score but stays under the mask;
+        # the second crosses quarantine_low -> PROBATION, mask reversed
+        srv.rpc_probe_result({"node_id": "lc-b", "elapsed": 0.01}, None)
+        assert srv.nodes["lc-b"]["health"] == "QUARANTINED"
+        srv.rpc_probe_result({"node_id": "lc-b", "elapsed": 0.01}, None)
+        assert srv.nodes["lc-b"]["health"] == "PROBATION"
+        assert srv.nodes["lc-b"]["quarantined"] is False
+        assert bool(srv.state.alive[srv.state.node_index("lc-b")])
+        # stale pre-quarantine EMAs dropped: probation judges fresh data
+        with srv._lock:
+            assert ("f", "lc-b") not in srv._dur_ema
+
+        # relapse: suspicion back over the bar re-quarantines instantly
+        # (no sustain grace on probation)
+        _seed_slow(srv, "lc-b")
+        srv._gray_sweep(now + 3)
+        assert srv.nodes["lc-b"]["health"] == "QUARANTINED"
+
+        # recover again, then probation_sweeps clean sweeps restore OK
+        srv.rpc_probe_result({"node_id": "lc-b", "elapsed": 0.01}, None)
+        srv.rpc_probe_result({"node_id": "lc-b", "elapsed": 0.01}, None)
+        assert srv.nodes["lc-b"]["health"] == "PROBATION"
+        srv._gray_sweep(now + 4)
+        srv._gray_sweep(now + 5)
+        assert srv.nodes["lc-b"]["health"] == "OK"
+        assert "lc-b" not in srv._quarantined
+    finally:
+        cluster.shutdown()
+
+
+def test_slow_probe_resets_recovery_progress():
+    """A probe answered slowly (the chaos exec hook stalls it on a
+    still-gray node) resets clean-probe progress and re-pins the score:
+    quarantine is sticky until the node actually answers fast."""
+    cluster = Cluster(config=Config(_lifecycle_overrides()))
+    cluster.add_node(num_cpus=1, node_id="sp-a")
+    cluster.wait_for_nodes(1)
+    srv = cluster.gcs
+    try:
+        _seed_slow(srv, "sp-a")
+        now = time.time()
+        srv._gray_sweep(now)
+        srv._gray_sweep(now + 1)
+        assert srv.nodes["sp-a"]["health"] == "QUARANTINED"
+        srv.rpc_probe_result({"node_id": "sp-a", "elapsed": 0.01}, None)
+        with srv._lock:
+            assert srv._health["sp-a"]["clean_probes"] == 1
+        srv.rpc_probe_result({"node_id": "sp-a", "elapsed": 3.0}, None)
+        with srv._lock:
+            assert srv._health["sp-a"]["clean_probes"] == 0
+            assert srv._health["sp-a"]["score"] >= \
+                srv.config.quarantine_high
+        assert srv.nodes["sp-a"]["health"] == "QUARANTINED"
+    finally:
+        cluster.shutdown()
+
+
+def test_overload_denominator_excludes_quarantined_cpus():
+    """Regression: _overload_check's CPU denominator rides state.alive,
+    which is False for quarantined rows — quarantining k nodes must
+    TIGHTEN the overload threshold for the survivors, not silently keep
+    counting the gray capacity."""
+    cluster = Cluster(config=Config({"log_to_driver": False}))
+    cluster.add_node(num_cpus=2, node_id="ov-a")
+    cluster.add_node(num_cpus=2, node_id="ov-b")
+    cluster.wait_for_nodes(2)
+    srv = cluster.gcs
+    try:
+        cpu_i = srv.space.index("CPU")
+
+        def alive_cpus():
+            with srv._lock:
+                return float(srv.state.total[srv.state.alive, cpu_i].sum())
+
+        assert alive_cpus() == 4.0
+        r = srv.rpc_quarantine_node({"node_id": "ov-b"}, None)
+        assert r["ok"] and r["quarantined"]
+        assert alive_cpus() == 2.0
+        r = srv.rpc_quarantine_node(
+            {"node_id": "ov-b", "unquarantine": True}, None)
+        assert r["ok"] and not r["quarantined"]
+        assert alive_cpus() == 4.0
+        assert srv.nodes["ov-b"]["health"] == "PROBATION"
+    finally:
+        cluster.shutdown()
+
+
+# ======================================== wedge-forever rescue (headline)
+
+
+def test_wedge_forever_speculation_rescue(tmp_path, monkeypatch,
+                                          invariant_sanitizer,
+                                          race_sanitizer):
+    """One node wedges EVERY execution of the task class forever (chaos
+    ``slow`` factor=inf) while staying ALIVE on heartbeats — the
+    fail-stop plane (retries, liveness timeouts) never fires. Straggler
+    speculation must re-run the wedged executions on the healthy node
+    and finish the whole job within the deadline. Runs under both the
+    protocol-invariant tracer and the happens-before race sanitizer;
+    the trace must show exactly-one winning task_done apply per task
+    and a released hold for every cancelled loser."""
+    spec = FaultSchedule(seed=5, rules=[
+        chaos.slow(node="gray-bad", factor=float("inf"), p=1.0,
+                   method="wedge_fn"),
+    ]).to_spec()
+    # workers are subprocesses: they join the fault plane via the env
+    # payload; the in-process daemons (probe hook) need install too
+    monkeypatch.setenv(chaos.ENV_SPEC, json.dumps(spec))
+    chaos.install_from_env()
+
+    overrides = {
+        "gray_defense_enabled": True,
+        "health_check_period_ms": 250.0,
+        "speculation_quantile_factor": 3.0,
+        "speculation_min_elapsed_s": 0.2,
+        "speculation_min_samples": 2,
+        "quarantine_sustain_sweeps": 2,
+        "probe_interval_s": 0.5,
+        "log_to_driver": False,
+    }
+    cluster = Cluster(config=Config(dict(overrides)))
+    cluster.add_node(num_cpus=2, node_id="gray-ok")
+    cluster.add_node(num_cpus=2, node_id="gray-bad")
+    cluster.wait_for_nodes(2)
+    ray_tpu.init(address=cluster.address, config=dict(overrides))
+    try:
+        @ray_tpu.remote(num_cpus=1, max_retries=2)
+        def wedge_fn(s):
+            time.sleep(s)
+            return 11
+
+        # 6 tasks over 4 CPUs: the first wave fills BOTH nodes, so two
+        # executions wedge on gray-bad; the healthy completions seed the
+        # class p95 ring past speculation_min_samples
+        t0 = time.perf_counter()
+        refs = [wedge_fn.remote(0.02) for _ in range(6)]
+        out = ray_tpu.get(refs, timeout=60.0)
+        assert out == [11] * 6
+        assert time.perf_counter() - t0 < 60.0
+
+        # health surface on the public API
+        rec = {n["NodeID"]: n for n in ray_tpu.nodes()}
+        for nid in ("gray-ok", "gray-bad"):
+            assert rec[nid]["Health"] in (
+                "OK", "SUSPECT", "QUARANTINED", "PROBATION")
+            assert 0.0 <= rec[nid]["Suspicion"] <= 1.0
+            assert isinstance(rec[nid]["Quarantined"], bool)
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+    done_per_task = {}
+    spec_dispatch = spec_cancels = 0
+    released_keys, cancelled_keys = set(), set()
+    for line in (tmp_path / "protocol_trace.jsonl").read_text().splitlines():
+        try:
+            ev = json.loads(line)
+        except ValueError:
+            continue
+        if ev.get("t") != "apply":
+            continue
+        k = ev.get("k")
+        if k == "task_done":
+            t = ev.get("task")
+            done_per_task[t] = done_per_task.get(t, 0) + 1
+        elif k == "dispatch" and ev.get("speculative"):
+            spec_dispatch += 1
+        elif k == "spec_cancel":
+            spec_cancels += 1
+            cancelled_keys.add(ev.get("key"))
+        elif k == "release" and ev.get("key"):
+            released_keys.add(ev.get("key"))
+    # the rescue actually went through speculation
+    assert spec_dispatch >= 1
+    assert spec_cancels >= 1  # each rescue cancelled its wedged primary
+    # exactly-one winning apply per task (losers are task_done_dup)
+    assert done_per_task and max(done_per_task.values()) == 1
+    # cancel-conservation: every cancelled loser's hold was released
+    assert cancelled_keys <= released_keys
+
+
+# =============================================== chaos slow-rule plane
+
+
+def test_chaos_slow_rule_shadowing_and_inf_spec_roundtrip():
+    """First-match-wins lets a method-scoped factor=inf rule shadow a
+    generic slow rule for one class only; factor=inf survives the
+    RAY_TPU_CHAOS_SPEC JSON round-trip; same seed + same stream =>
+    byte-identical fired-fault traces."""
+    s = FaultSchedule(seed=3, rules=[
+        chaos.slow(node="n-1", factor=float("inf"), p=1.0,
+                   method="wedge"),
+        chaos.slow(node="n-1", factor=25.0, p=1.0),
+    ])
+    assert s.on_exec("n-1", "wedge") == float("inf")
+    assert s.on_exec("n-1", "other") == 25.0
+    assert s.on_exec("n-2", "wedge") == 1.0  # off-node: full speed
+
+    spec = json.loads(json.dumps(s.to_spec()))  # env-payload round-trip
+    s2 = FaultSchedule.from_spec(spec)
+    assert s2.on_exec("n-1", "wedge") == float("inf")
+    assert s2.on_exec("n-1", "other") == 25.0
+
+    def drive(sch):
+        for _ in range(5):
+            sch.on_exec("n-1", "wedge")
+            sch.on_exec("n-1", None)
+            sch.on_exec("n-9", "wedge")
+        return sch.trace_text()
+
+    t1 = drive(FaultSchedule.from_spec(spec))
+    t2 = drive(FaultSchedule.from_spec(spec))
+    assert t1 and t1 == t2
+
+
+# ========================================== serve fast-path health weight
+
+
+def _pick_share(susp_gray, rounds=300):
+    """Closed-loop share of the replica on the suspected node: each pick
+    wins one in-flight slot and nothing completes, so pow-2 load
+    feedback is the only equalizer."""
+    from ray_tpu.serve.fastpath import FastPathRouter, _Pair
+
+    susp = {"n-ok": 0.0, "n-gray": susp_gray}
+    r = FastPathRouter.__new__(FastPathRouter)
+    r._lock = threading.Lock()
+    r._actor_ids = ["a", "b"]
+    r._dead = set()
+    r._max_inflight = 0
+    pairs = {"a": _Pair("p1", "a", "n-ok", None, None),
+             "b": _Pair("p2", "b", "n-gray", None, None)}
+    r._pairs = pairs
+    r._rng = random.Random(7)
+    r._rt = SimpleNamespace(node_suspicion=lambda nid: susp[nid])
+    wins = {"a": 0, "b": 0}
+    for _ in range(rounds):
+        aid, why = r._pick(set())
+        assert why is None
+        wins[aid] += 1
+        pairs[aid].inflight += 1
+    return wins["b"] / rounds
+
+
+def test_fastpath_pick_share_decays_with_suspicion():
+    """Regression for the health-weighted pow-2 router: a replica on an
+    ALIVE-but-DEGRADED node loses request share monotonically as its
+    node's suspicion grows — decay, not exclusion."""
+    s0, s3, s9 = _pick_share(0.0), _pick_share(0.3), _pick_share(0.9)
+    assert 0.4 <= s0 <= 0.6          # healthy: pow-2 splits evenly
+    assert s9 < s3 < s0              # monotone decay in suspicion
+    assert s9 < 0.25                 # heavy suspicion: share collapses
+
+    # ...but never to zero: a big enough load gap on the healthy
+    # replica still routes to the gray one (graceful, not a blacklist)
+    assert s9 > 0.0
+
+
+def test_fastpath_pick_suspicion_breaks_inflight_ties():
+    """At equal in-flight, the suspected node loses outright."""
+    from ray_tpu.serve.fastpath import FastPathRouter, _Pair
+
+    r = FastPathRouter.__new__(FastPathRouter)
+    r._lock = threading.Lock()
+    r._actor_ids = ["a", "b"]
+    r._dead = set()
+    r._max_inflight = 0
+    r._pairs = {"a": _Pair("p1", "a", "n-ok", None, None),
+                "b": _Pair("p2", "b", "n-gray", None, None)}
+    r._rng = random.Random(11)
+    r._rt = SimpleNamespace(
+        node_suspicion=lambda nid: 0.8 if nid == "n-gray" else 0.0)
+    for _ in range(50):
+        aid, _why = r._pick(set())
+        assert aid == "a"
+
+
+# ================================================= static model surface
+
+
+def test_node_health_statemachine_registered():
+    """The secondary-field machine the static gate checks GCS writes
+    against: the node-health lifecycle with exactly the sweep's edges."""
+    from ray_tpu.analysis import statemachine as sm
+
+    assert sm.FIELD_MACHINES[("node", "health")] == "node-health"
+    m = sm.MACHINES["node-health"]
+    assert m.initial == frozenset({"OK"})
+    assert m.states == frozenset(
+        {"OK", "SUSPECT", "QUARANTINED", "PROBATION"})
+    assert m.edges == frozenset({
+        ("OK", "SUSPECT"), ("SUSPECT", "OK"),
+        ("SUSPECT", "QUARANTINED"), ("OK", "QUARANTINED"),
+        ("QUARANTINED", "PROBATION"), ("PROBATION", "OK"),
+        ("PROBATION", "QUARANTINED"),
+    })
