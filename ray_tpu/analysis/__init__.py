@@ -8,10 +8,14 @@ the protocol checkers over :mod:`ray_tpu.analysis.protocol`'s extracted
 RPC model: rpc-method-unknown, rpc-payload-key-mismatch,
 push-topic-unknown, config-key-unknown, and the lifecycle checkers over
 :mod:`ray_tpu.analysis.statemachine`'s declared/extracted state
-machines: illegal-state-transition, cross-thread-field-write) with
-per-line ``# ray-lint: disable=<check>`` pragmas and a committed
-ratchet baseline. ``--dump-protocol`` emits the protocol model
-(including the state machines) as JSON.
+machines: illegal-state-transition, cross-thread-field-write, and the
+blocking-graph checkers over :mod:`ray_tpu.analysis.waitgraph`:
+blocking-wait-under-lock, rpc-reentry-cycle) with per-line
+``# ray-lint: disable=<check>`` pragmas and a committed ratchet
+baseline. ``--dump-protocol`` emits the protocol model (including the
+state machines) as JSON; ``--dump-waitgraph`` emits the interprocedural
+blocking graph (execution contexts -> blocking sites -> cross-process
+RPC edges) whose cycles are potential distributed deadlocks.
 
 Runtime half: :mod:`ray_tpu.analysis.sanitizer` is the shared lock
 instrumentation seam (refcounted ``Lock``/``RLock``/``Condition``
@@ -27,10 +31,18 @@ watchlist (``--dump-watchlist``) and *validated* by a FastTrack-style
 vector-clock engine over the live control-plane threads
 (``race_sanitizer`` fixture / ``--race`` / ``chaos_soak --race``;
 seeded regression teeth in ``node_daemon.SEEDED_BUGS`` +
-``serve.fastpath.SEEDED_BUGS``) — each runtime sanitizer is the
-dynamic cross-check of its static model, and the racer reports a race
-on a statically-credited-locked field as a finding against the static
-analysis itself.
+``serve.fastpath.SEEDED_BUGS``); and
+:mod:`ray_tpu.analysis.waitgraph`'s ``WaitSanitizer`` — the hybrid
+wait-for deadlock & stall sanitizer: every lock/queue/future/condition
+wait, RPC awaiting a reply, and dag-channel slow-tier park becomes a
+node in a live cross-thread AND cross-process wait-for graph, probed
+for cycles (deadlock reports carry both stacks + held sets + the RPC
+chain) and scanned for stalls by a watchdog (``wait_sanitizer``
+fixture / ``--wait`` / ``chaos_soak --stall`` / ``ray_tpu stacks``;
+seeded teeth in ``gcs.SEEDED_BUGS`` + ``dag.compiled.SEEDED_BUGS``) —
+each runtime sanitizer is the dynamic cross-check of its static model,
+and the racer reports a race on a statically-credited-locked field as
+a finding against the static analysis itself.
 
 Model-checking half: :mod:`ray_tpu.analysis.explore` runs the real GCS
 handler object under a virtual runtime and *searches* handler

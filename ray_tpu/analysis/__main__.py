@@ -71,8 +71,25 @@ def main(argv=None) -> int:
                         "vector-clock engine; exit 1 on any detected "
                         "race (--seed-bug re-introduces a known bug the "
                         "probe must then catch)")
+    p.add_argument("--wait", default=None, metavar="PROBE",
+                   nargs="?", const="all",
+                   help="instead of linting, run the wait-graph "
+                        "deadlock sanitizer's probe(s) "
+                        "(analysis/waitgraph.py): one probe (or 'all') "
+                        "drives real control-plane code paths on "
+                        "controlled threads under the live wait-for "
+                        "graph; exit 1 on any deadlock report "
+                        "(--seed-bug re-introduces a known blocking "
+                        "bug the probe must then catch)")
     p.add_argument("--rounds", type=int, default=3,
-                   help="quiescence rounds per race probe (default 3)")
+                   help="quiescence rounds per race/wait probe "
+                        "(default 3)")
+    p.add_argument("--dump-waitgraph", action="store_true",
+                   help="instead of linting, emit the STATIC blocking "
+                        "graph as JSON: (context, blocking-site) nodes "
+                        "over cluster//serve//dag/, cross-process RPC "
+                        "edges resolved through the protocol index, "
+                        "and any blocking cycles found over them")
     p.add_argument("--dump-watchlist", action="store_true",
                    help="instead of linting, emit the race sanitizer's "
                         "STAGE-1 static watchlist as JSON: every "
@@ -84,8 +101,10 @@ def main(argv=None) -> int:
                    help="list every model-checking/sanitizer scenario, "
                         "kind-prefixed: control-plane interleaving "
                         "scenarios (--explore NAME), 'memmodel:NAME' "
-                        "channel scenarios (--memmodel NAME), and "
-                        "'racer:NAME' race probes (--race NAME)")
+                        "channel scenarios (--memmodel NAME), "
+                        "'racer:NAME' race probes (--race NAME), and "
+                        "'waitgraph:NAME' deadlock probes (--wait "
+                        "NAME)")
     p.add_argument("--budget", type=int, default=500,
                    help="DFS schedule budget per scenario (default 500)")
     p.add_argument("--samples", type=int, default=200,
@@ -103,7 +122,8 @@ def main(argv=None) -> int:
                    help="re-introduce a known fixed bug (gcs.SEEDED_BUGS "
                         "for --explore, channel.SEEDED_BUGS for "
                         "--memmodel, node_daemon/fastpath SEEDED_BUGS "
-                        "for --race) — the regression harness")
+                        "for --race, gcs/compiled SEEDED_BUGS for "
+                        "--wait) — the regression harness")
     p.add_argument("--save-replay", default=None, metavar="FILE",
                    help="write the first (shrunk) counterexample here")
     p.add_argument("--replay", default=None, metavar="FILE",
@@ -139,6 +159,11 @@ def main(argv=None) -> int:
         for name in sorted(RACE_PROBES):
             doc = (RACE_PROBES[name].__doc__ or "").split("\n")[0].strip()
             print(f"racer:{name}: {doc}")
+        from ray_tpu.analysis.waitgraph import WAIT_PROBES
+
+        for name in sorted(WAIT_PROBES):
+            doc = (WAIT_PROBES[name].__doc__ or "").split("\n")[0].strip()
+            print(f"waitgraph:{name}: {doc}")
         return 0
 
     if args.replay is not None:
@@ -241,6 +266,64 @@ def main(argv=None) -> int:
                               f"locks={a.get('locks')}")
                         for fr in a.get("stack", ())[:3]:
                             print(f"      {fr}")
+        return 1 if failed else 0
+
+    if args.dump_waitgraph:
+        from ray_tpu.analysis import waitgraph as _wg
+
+        paths = None
+        if args.paths and args.paths != ["ray_tpu"]:
+            missing = [p_ for p_ in args.paths if not os.path.exists(p_)]
+            if missing:
+                print(f"error: no such path(s): {missing}",
+                      file=sys.stderr)
+                return 2
+            paths = args.paths
+        try:
+            report = _wg.build_waitgraph(paths=paths)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        print(json.dumps(report.to_dict(), indent=2))
+        return 1 if report.cycles else 0
+
+    if args.wait is not None:
+        from ray_tpu.analysis import waitgraph as _wg
+
+        # accept the "waitgraph:NAME" spelling --list-scenarios prints
+        requested = args.wait.split("waitgraph:", 1)[-1]
+        names = (
+            sorted(_wg.WAIT_PROBES) if requested == "all"
+            else [requested]
+        )
+        unknown = [n for n in names if n not in _wg.WAIT_PROBES]
+        if unknown:
+            print(f"error: unknown wait probe(s) {unknown}; have "
+                  f"{sorted(_wg.WAIT_PROBES)}", file=sys.stderr)
+            return 2
+        failed = False
+        for name in names:
+            try:
+                res = _wg.run_probe(
+                    name, seeded_bugs=args.seed_bug, rounds=args.rounds,
+                )
+            except ValueError as e:  # unknown --seed-bug name
+                print(f"error: {e}", file=sys.stderr)
+                return 2
+            print(res.summary())
+            if res.detected:
+                failed = True
+                for d in res.deadlocks:
+                    print(f"  DEADLOCK cycle: "
+                          f"{' -> '.join(d.get('cycle', ()))}")
+                    for t in d.get("threads", ()):
+                        print(f"    {t.get('thread')} waiting on "
+                              f"{t.get('waiting_on')} "
+                              f"held={t.get('held')}")
+                        for fr in (t.get("stack") or ())[-3:]:
+                            print(f"      {fr}")
+                    for hop in d.get("rpc_chain", ()):
+                        print(f"    rpc hop: {hop}")
         return 1 if failed else 0
 
     if args.memmodel is not None:

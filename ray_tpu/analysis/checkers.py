@@ -2058,3 +2058,168 @@ class RpcUnderLockChecker(Checker):
                     "lock, call after), or suppress with "
                     "`# ray-lint: disable=rpc-under-lock`",
                 ))
+
+
+@register
+class BlockingWaitUnderLockChecker(Checker):
+    """Generalizes `rpc-under-lock` to every OTHER blocking wait the
+    waitgraph classifier knows (chained ``call_async(...).result()``,
+    bare ``Future.result``, ``queue.get``, ``Condition.wait``,
+    ``Thread.join``, ``Channel.read/write``): the lock is pinned for
+    the whole wait, and whoever must release the awaited resource may
+    need that lock — the lock-channel / lock-RPC halves of the wait
+    cycles the dynamic WaitSanitizer hunts. Same lock machinery and
+    same-class propagation as ``rpc-under-lock``; the ``with self._cv:
+    self._cv.wait()`` condition idiom is exempt (waiting RELEASES the
+    lock it waits on)."""
+
+    name = "blocking-wait-under-lock"
+    description = (
+        "blocking wait (chained rpc result, future, queue get, "
+        "condition wait, thread join, channel read/write) while "
+        "holding a class `threading` lock: the lock is pinned for the "
+        "whole wait and the releaser may need it"
+    )
+
+    def check_module(self, ctx: ModuleContext) -> List[Finding]:
+        parts = ctx.relpath.replace("\\", "/").split("/")
+        if not (set(parts[:-1]) & _CONTROL_PLANE_SEGMENTS):
+            return []
+        out: List[Finding] = []
+        for cls in ast.walk(ctx.tree):
+            if isinstance(cls, ast.ClassDef):
+                self._check_class(ctx, cls, out)
+        return out
+
+    @staticmethod
+    def _receiver_attr(node: ast.Call) -> Optional[str]:
+        v = node.func.value
+        if isinstance(v, ast.Attribute) and isinstance(
+            v.value, ast.Name
+        ) and v.value.id == "self":
+            return v.attr
+        return None
+
+    def _check_class(self, ctx, cls: ast.ClassDef, out) -> None:
+        from ray_tpu.analysis.racer import _locks_covering
+        from ray_tpu.analysis.waitgraph import (
+            WAIT_KINDS_UNDER_LOCK, blocking_wait_kind)
+
+        helper = CrossThreadFieldWriteChecker()
+        lock_attrs = helper._lock_attrs(cls)
+        if not lock_attrs:
+            return
+        methods = {
+            n.name: n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        called_locked: Dict[str, bool] = {
+            name: name.endswith("_locked") for name in methods
+        }
+        work = [n for n, locked in called_locked.items() if locked]
+        for name, fn in methods.items():
+            for callee, under in helper._calls_of(fn, lock_attrs):
+                if under and callee in methods \
+                        and not called_locked[callee]:
+                    called_locked[callee] = True
+                    work.append(callee)
+        while work:
+            name = work.pop()
+            for callee, _under in helper._calls_of(
+                methods[name], lock_attrs
+            ):
+                if callee in methods and not called_locked[callee]:
+                    called_locked[callee] = True
+                    work.append(callee)
+        for name, fn in methods.items():
+            locked_ids = helper._nodes_under_lock(fn, lock_attrs)
+            covering = _locks_covering(fn, lock_attrs)
+            whole_fn_locked = called_locked[name]
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                k = blocking_wait_kind(node)
+                if k is None or k[0] not in WAIT_KINDS_UNDER_LOCK:
+                    continue
+                kind, method = k
+                lexically = id(node) in locked_ids
+                if not (lexically or whole_fn_locked):
+                    continue
+                if kind == "cond-wait":
+                    # waiting on a condition RELEASES the lock it waits
+                    # on: flag only when some OTHER lock stays held
+                    recv = self._receiver_attr(node)
+                    if lexically:
+                        held = covering.get(id(node), frozenset())
+                        if not (held - ({recv} if recv else set())):
+                            continue
+                    elif recv is not None and recv in lock_attrs:
+                        continue
+                how = (
+                    "inside `with self.<lock>:`" if lexically
+                    else "in a method reached from under the class lock"
+                )
+                what = f"blocking {kind}" + (
+                    f" `{method}`" if method else ""
+                )
+                out.append(ctx.finding(
+                    node, self.name,
+                    f"{what} {how} ({'/'.join(sorted(lock_attrs))}): "
+                    "the lock is pinned for the whole wait and the "
+                    "releaser may need it — hoist the wait out of the "
+                    "critical section (snapshot under the lock, wait "
+                    "after), or suppress with "
+                    "`# ray-lint: disable=blocking-wait-under-lock`",
+                ))
+
+
+@register
+class RpcReentryCycleChecker(Checker):
+    """A handler whose blocking RPC chain can re-enter its own server
+    class — the GCS→daemon→GCS shape. With a bounded dispatcher every
+    such chain is one concurrent burst away from thread exhaustion, and
+    under a held lock it is a cross-process deadlock. Whole-program:
+    modules accumulate through ``check_module`` (helpers outside the
+    control plane must still resolve), the blocking graph builds once
+    in ``finalize`` via :func:`ray_tpu.analysis.waitgraph.
+    build_from_contexts` and every reentry chain is reported at the
+    originating handler's first blocking RPC site."""
+
+    name = "rpc-reentry-cycle"
+    description = (
+        "rpc handler whose blocking rpc chain re-enters its own server "
+        "class: the reply depends on a dispatcher slot the caller may "
+        "hold (thread exhaustion; deadlock under a lock)"
+    )
+
+    def __init__(self) -> None:
+        self._ctxs: List[ModuleContext] = []
+
+    def check_module(self, ctx: ModuleContext) -> List[Finding]:
+        self._ctxs.append(ctx)
+        return []
+
+    def finalize(self) -> List[Finding]:
+        from ray_tpu.analysis import waitgraph as _wg
+
+        if not self._ctxs:
+            return []
+        report = _wg.build_from_contexts(self._ctxs, root="")
+        out: List[Finding] = []
+        for entry in _wg.reentry_chains(report):
+            site = entry["site"]
+            chain = " -> ".join(entry["chain"])
+            out.append(Finding(
+                path=site.path, line=site.line, col=0,
+                check=self.name,
+                message=(
+                    f"blocking rpc `{site.method}` starts a chain that "
+                    f"re-enters this handler's own server ({chain}): "
+                    "the reply depends on a dispatcher slot the caller "
+                    "may be holding — break the cycle (async notify, "
+                    "or move the work off the handler), or suppress "
+                    "with `# ray-lint: disable=rpc-reentry-cycle`"
+                ),
+                line_text="", end_line=site.end_line,
+            ))
+        return out

@@ -98,7 +98,7 @@ KNOWN_SEEDED_BUGS = tuple(b for b, _ in SEEDED_BUG_SCENARIOS)
 #: ``error`` are write-once blind-store words.
 WORD_NAMES = (
     "magic", "closed", "error", "version", "ack", "len", "wclock",
-    "rclock", "capacity",
+    "rclock", "capacity", "cpid", "apid",
 )
 
 

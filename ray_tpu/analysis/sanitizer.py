@@ -90,7 +90,13 @@ def _caller_site(depth: int = 2) -> Tuple[str, int]:
 def add_listener(listener) -> None:
     """Register a listener (optional methods: ``on_lock_created(lock,
     site)``, ``on_acquire(lock, site, held)`` — *held* is the site list
-    BEFORE this acquisition is pushed — and ``on_release(lock, site)``).
+    BEFORE this acquisition is pushed — ``on_release(lock, site)``,
+    and the blocked-waiter pair ``on_acquire_begin(lock, site)`` /
+    ``on_acquire_abort(lock, site)``: *begin* fires BEFORE a blocking
+    acquire parks, *abort* fires if that acquire then fails or times
+    out, and a successful one resolves through ``on_acquire`` as usual —
+    the wait-graph sanitizer needs the begin edge because a deadlocked
+    thread, by definition, never reaches ``on_acquire``).
     The first listener installs the factory patches."""
     global _listeners, _orig_factories
     with _listeners_mu:
@@ -179,6 +185,18 @@ class _InstrumentedLock:
                 fn(self, self._site, [s for s, _lk in held])
         held.append((self._site, self))
 
+    def _notify_acquire_begin(self):
+        for lst in _listeners:
+            fn = getattr(lst, "on_acquire_begin", None)
+            if fn is not None:
+                fn(self, self._site)
+
+    def _notify_acquire_abort(self):
+        for lst in _listeners:
+            fn = getattr(lst, "on_acquire_abort", None)
+            if fn is not None:
+                fn(self, self._site)
+
     def _notify_releasing(self):
         held = _held_stack()
         # Locks are usually released LIFO; tolerate out-of-order release.
@@ -194,9 +212,17 @@ class _InstrumentedLock:
     # ------------------------------------------------------ Lock proto
 
     def acquire(self, blocking: bool = True, timeout: float = -1):
+        # begin fires only for acquires that can PARK: a deadlocked
+        # thread never returns from the inner acquire, so a post-hoc
+        # on_acquire can never see it — the wait edge must precede it
+        began = blocking
+        if began:
+            self._notify_acquire_begin()
         ok = self._inner.acquire(blocking, timeout)
         if ok:
             self._notify_acquired()
+        elif began:
+            self._notify_acquire_abort()
         return ok
 
     def release(self):
@@ -228,6 +254,10 @@ class _InstrumentedLock:
             return _release_save
         if name == "_acquire_restore":
             def _acquire_restore(state):
+                # Condition.wait's hidden reacquire can park behind the
+                # notifier: the begin/acquired pair makes that wait
+                # visible to the wait-graph listener too
+                self._notify_acquire_begin()
                 val(state)
                 self._notify_acquired()
             return _acquire_restore

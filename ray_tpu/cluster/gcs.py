@@ -1539,6 +1539,22 @@ class GcsServer:
         with self._lock:
             info = self.running.get(p["task_id"])
             node_id = info.get("node_id") if info else None
+            if "stream-ack-under-lock" in SEEDED_BUGS and node_id:
+                # SEEDED BUG (test-only; see SEEDED_BUGS above): block on
+                # the daemon's reply while HOLDING the GCS lock — the
+                # daemon handler that needs this lock then wedges the
+                # whole control plane (the GCS->daemon->GCS wait cycle
+                # the waitgraph sanitizer must catch)
+                c = self._daemon_client(node_id)
+                if c is not None:
+                    try:
+                        c.call_async("stream_ack", {
+                            "task_id": p["task_id"],
+                            "consumed": int(p["consumed"]),
+                        }).result(timeout=2.0)  # ray-lint: disable=blocking-wait-under-lock
+                    except Exception:  # noqa: BLE001 - probe unwedge path
+                        pass
+                return {"ok": True}
         if node_id is not None:
             c = self._daemon_client(node_id)
             if c is not None:

@@ -29,6 +29,8 @@ word  name       meaning
 7     rclock     reader's Lamport clock at ack (trace merge)
 8     capacity   payload-area size; readers remap when len exceeds
                  what they mapped (writer grows the file in place)
+9     cpid       creator (writer) end's os pid — stall attribution
+10    apid       attacher (reader) end's os pid (0 = never attached)
 ====  =========  ====================================================
 
 Protocol (strict alternation — the invariant the exec loop traces):
@@ -146,6 +148,8 @@ HEADER_LAYOUT: Tuple[Tuple[str, str], ...] = (
     ("wclock", "writer's Lamport clock at commit (trace merge)"),
     ("rclock", "reader's Lamport clock at ack (trace merge)"),
     ("capacity", "payload-area size; readers remap when len exceeds it"),
+    ("cpid", "creator (writer) end's os pid, stamped in create()"),
+    ("apid", "attacher (reader) end's os pid, stamped in open_wait()"),
 )
 
 WORDS = {name: i for i, (name, _) in enumerate(HEADER_LAYOUT)}
@@ -159,6 +163,8 @@ _W_LEN = WORDS["len"]
 _W_WCLOCK = WORDS["wclock"]
 _W_RCLOCK = WORDS["rclock"]
 _W_CAP = WORDS["capacity"]
+_W_CPID = WORDS["cpid"]
+_W_APID = WORDS["apid"]
 
 _U64 = struct.Struct("<Q")
 
@@ -171,6 +177,16 @@ _U64 = struct.Struct("<Q")
 #: - ``skip-remap-reread``: skip the reader's grow-in-place remap check,
 #:   so a frame larger than the reader's mapping reads stale bytes.
 SEEDED_BUGS: set = set()
+
+#: Wait-graph seam (mirror of ``rpc.TRACE`` / ``racer.RACER``): the
+#: installed :class:`ray_tpu.analysis.waitgraph.WaitSanitizer`, or None.
+#: Consulted only when a wait loop crosses into its SLOW park tier
+#: (``spins == spin_hot`` — once per wait, never on the hot path), plus
+#: once per end at create/attach. A parked channel end is otherwise
+#: indistinguishable from a wedged one to every other layer; the
+#: park-begin/park-end stamps let stall attribution name the channel,
+#: its peer end's pid and the last committed seq.
+PARKWATCH = None
 
 # Chaos hook for the worker-kill-at-mid-commit test: when set (env
 # RAY_TPU_CHAN_CRASH_AT, honored only in daemon-spawned worker processes
@@ -338,6 +354,7 @@ class Channel:
         self._get = mem.load
         self._put = mem.store
         self._closed_local = False
+        self._wg_created = False  # True on the create() (writer) end
         # polls before a waiting end yields the core (see _park). The dag
         # driver loop keeps the hot default (its peer answers in
         # microseconds and owns a core); the serve fast path turns this
@@ -375,10 +392,15 @@ class Channel:
         mem = MmapMem.create(path, capacity)
         ch = cls(path, mem, key)
         for w in (_W_CLOSED, _W_ERROR, _W_VERSION, _W_ACK, _W_LEN,
-                  _W_WCLOCK, _W_RCLOCK):
+                  _W_WCLOCK, _W_RCLOCK, _W_APID):
             ch._put(w, 0)
         ch._put(_W_CAP, capacity)
+        ch._put(_W_CPID, os.getpid())
         ch._put(_W_MAGIC, MAGIC)  # last: publishes the header to readers
+        ch._wg_created = True
+        pw = PARKWATCH
+        if pw is not None:
+            pw.chan_open(ch, "writer")
         return ch
 
     @classmethod
@@ -394,7 +416,12 @@ class Channel:
                 mem = None  # not created yet: poll; real I/O errors raise
             if mem is not None:
                 if mem.load(_W_MAGIC) == MAGIC:
-                    return cls(path, mem, key)
+                    ch = cls(path, mem, key)
+                    ch._put(_W_APID, os.getpid())
+                    pw = PARKWATCH
+                    if pw is not None:
+                        pw.chan_open(ch, "reader")
+                    return ch
                 mem.close()
             if should_stop is not None and should_stop():
                 raise ChannelClosedError(f"channel {key} never appeared "
@@ -454,6 +481,30 @@ class Channel:
             )
         raise ChannelClosedError(f"channel {self.key} is closed")
 
+    def wait_state(self) -> dict:
+        """Stall-attribution snapshot (sanctioned ``_get`` loads): the
+        last committed seq, the last consumed seq, and the close/error
+        words — what a stall report needs to say WHY this end is parked
+        (``version == ack`` = writer waiting on the reader's ack;
+        ``version > ack`` = reader has an unconsumed frame ready)."""
+        if self._mem is None:
+            return {"state": "detached"}
+        return {
+            "version": self._get(_W_VERSION),
+            "ack": self._get(_W_ACK),
+            "closed": bool(self._get(_W_CLOSED)),
+            "errored": bool(self._get(_W_ERROR)),
+        }
+
+    def peer_pid(self) -> Optional[int]:
+        """The OTHER end's os pid (None = peer never attached / this end
+        is detached). The creating end reads ``apid``, an attaching end
+        reads ``cpid``."""
+        if self._mem is None:
+            return None
+        pid = self._get(_W_APID) if self._wg_created else self._get(_W_CPID)
+        return pid or None
+
     def _park(self, spins: int) -> None:
         # adaptive wait: stay hot for the first spin_hot polls (same-host
         # hand-off is microseconds), then yield the core
@@ -471,21 +522,37 @@ class Channel:
         t0 = time.monotonic() if _metrics.ENABLED else 0.0
         deadline = None if timeout is None else time.monotonic() + timeout
         spins = 0
-        while True:
-            if self._get(_W_ERROR) or self._get(_W_CLOSED):
-                self._raise_closed()
-            version = self._get(_W_VERSION)
-            if self._get(_W_ACK) == version:
-                break
-            if should_stop is not None and should_stop():
-                raise ChannelClosedError(f"channel {self.key}: stage stopping")
-            if deadline is not None and time.monotonic() >= deadline:
-                raise ChannelTimeoutError(
-                    f"write on {self.key} timed out waiting for reader ack "
-                    f"(seq {version} unconsumed)"
-                )
-            self._park(spins)
-            spins += 1
+        wrec = None
+        try:
+            while True:
+                if self._get(_W_ERROR) or self._get(_W_CLOSED):
+                    self._raise_closed()
+                version = self._get(_W_VERSION)
+                if self._get(_W_ACK) == version:
+                    break
+                if should_stop is not None and should_stop():
+                    raise ChannelClosedError(
+                        f"channel {self.key}: stage stopping")
+                if deadline is not None and time.monotonic() >= deadline:
+                    raise ChannelTimeoutError(
+                        f"write on {self.key} timed out waiting for reader "
+                        f"ack (seq {version} unconsumed)"
+                    )
+                if spins == self.spin_hot:
+                    # crossing into the slow park tier: this wait is no
+                    # longer a microsecond hand-off — stamp it so the
+                    # stall watchdog can attribute a wedge (one consult
+                    # per wait, never on the hot path)
+                    pw = PARKWATCH
+                    if pw is not None:
+                        wrec = pw.park_begin(self, "write")
+                self._park(spins)
+                spins += 1
+        finally:
+            if wrec is not None:
+                pw = PARKWATCH
+                if pw is not None:
+                    pw.park_end(self, "write", wrec)
         seq = version + 1
         need = len(payload)
         cap = self._get(_W_CAP)
@@ -528,29 +595,43 @@ class Channel:
         t0 = time.monotonic() if _metrics.ENABLED else 0.0
         deadline = None if timeout is None else time.monotonic() + timeout
         spins = 0
-        while True:
-            if self._get(_W_ERROR):
-                self._raise_closed()
-            # closed is sampled BEFORE version: the writer publishes its
-            # last commit before closing, so closed==1 here implies the
-            # version load below already sees every committed frame —
-            # the reversed order let a racing graceful close drop a
-            # committed final frame (caught by memmodel's first run)
-            closed = self._get(_W_CLOSED)
-            ack = self._get(_W_ACK)
-            version = self._get(_W_VERSION)
-            if version > ack:
-                break
-            if closed:
-                self._raise_closed()  # closed AND drained
-            if should_stop is not None and should_stop():
-                raise ChannelClosedError(f"channel {self.key}: stage stopping")
-            if deadline is not None and time.monotonic() >= deadline:
-                raise ChannelTimeoutError(
-                    f"read on {self.key} timed out at seq {ack}"
-                )
-            self._park(spins)
-            spins += 1
+        wrec = None
+        try:
+            while True:
+                if self._get(_W_ERROR):
+                    self._raise_closed()
+                # closed is sampled BEFORE version: the writer publishes
+                # its last commit before closing, so closed==1 here
+                # implies the version load below already sees every
+                # committed frame — the reversed order let a racing
+                # graceful close drop a committed final frame (caught by
+                # memmodel's first run)
+                closed = self._get(_W_CLOSED)
+                ack = self._get(_W_ACK)
+                version = self._get(_W_VERSION)
+                if version > ack:
+                    break
+                if closed:
+                    self._raise_closed()  # closed AND drained
+                if should_stop is not None and should_stop():
+                    raise ChannelClosedError(
+                        f"channel {self.key}: stage stopping")
+                if deadline is not None and time.monotonic() >= deadline:
+                    raise ChannelTimeoutError(
+                        f"read on {self.key} timed out at seq {ack}"
+                    )
+                if spins == self.spin_hot:
+                    # slow-tier transition: see the write() twin above
+                    pw = PARKWATCH
+                    if pw is not None:
+                        wrec = pw.park_begin(self, "read")
+                self._park(spins)
+                spins += 1
+        finally:
+            if wrec is not None:
+                pw = PARKWATCH
+                if pw is not None:
+                    pw.park_end(self, "read", wrec)
         need = self._get(_W_LEN)
         if "skip-remap-reread" not in SEEDED_BUGS:
             # grow-in-place: the writer may have grown the file under us;

@@ -31,6 +31,7 @@ which pushes ``dag_update`` to the owner — the driver's next (or parked)
 from __future__ import annotations
 
 import socket
+import threading
 import time
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional
@@ -50,6 +51,14 @@ from ray_tpu.dag.channel import (
     ChannelClosedError,
     ChannelTimeoutError,
 )
+
+
+# TEST-ONLY regression switchboard (same protocol as gcs.SEEDED_BUGS):
+# names added here re-introduce known, FIXED driver-side bugs so the
+# waitgraph sanitizer's seeded-probe harness can prove it still catches
+# them. Empty in production; never consulted on a hot path beyond a
+# set-membership test inside the affected method.
+SEEDED_BUGS: set = set()
 
 
 @dataclass
@@ -184,6 +193,10 @@ class CompiledDAG:
         self._seq = 0
         self._poisoned: Optional[str] = None  # set on partial input commit
         self._torn_down = False
+        # lifecycle lock: `__del__`-driven teardown (gc on an arbitrary
+        # thread) can race an explicit teardown() — the torn-down flag's
+        # check-and-set must be atomic or both sides release channels
+        self._life_lock = threading.Lock()
         self._inputs: List[Any] = []   # writer ends, driver side
         self._outputs: List[Any] = []  # reader ends, driver side
         self._trace_spans = False
@@ -480,27 +493,9 @@ class CompiledDAG:
                 raise
             for r in self._outputs:
                 deadline = time.monotonic() + timeout
-                while True:
-                    try:
-                        seq, data = r.read(
-                            timeout=max(0.05, deadline - time.monotonic()),
-                            should_stop=_broken_probe,
-                        )
-                    except ChannelTimeoutError:
-                        # a remote reader bounds each attempt (~30s) below
-                        # the full deadline: retry until ours expires
-                        if time.monotonic() >= deadline:
-                            raise
-                        err = self._broken()
-                        if err:
-                            raise ChannelClosedError(err) from None
-                        continue
-                    # frames are seq-stamped: drop stale ones left by an
-                    # earlier timed-out iteration (the stage still
-                    # committed its result after the driver gave up)
-                    # instead of returning iteration N-1's output as N
-                    if seq >= self._seq:
-                        break
+                seq, data = self._read_output(
+                    r, deadline, should_stop=_broken_probe
+                )
                 results.append(serialization.unpack(data))
         except ChannelClosedError:
             # prefer the control plane's cause (worker/node death detail)
@@ -521,13 +516,52 @@ class CompiledDAG:
         values = [rec["v"] for rec in results]
         return values if self._multi_output else values[0]
 
+    def _read_output(self, r, deadline, should_stop=None):
+        """One output-channel read with the broken-DAG retry loop."""
+        if "chan-read-under-lock" in SEEDED_BUGS:
+            # SEEDED BUG (test-only; see SEEDED_BUGS above): park the
+            # read while HOLDING the lifecycle lock — a concurrent
+            # teardown() wedges on _life_lock while this read waits on
+            # a channel only the teardown side can unblock (the
+            # lock-channel wait cycle the waitgraph sanitizer must
+            # catch)
+            with self._life_lock:
+                return self._read_output_retry(r, deadline, should_stop)
+        return self._read_output_retry(r, deadline, should_stop)
+
+    def _read_output_retry(self, r, deadline, should_stop=None):
+        while True:
+            try:
+                seq, data = r.read(  # ray-lint: disable=blocking-wait-under-lock
+                    timeout=max(0.05, deadline - time.monotonic()),
+                    should_stop=should_stop,
+                )
+            except ChannelTimeoutError:
+                # a remote reader bounds each attempt (~30s) below
+                # the full deadline: retry until ours expires
+                if time.monotonic() >= deadline:
+                    raise
+                err = self._broken()
+                if err:
+                    raise ChannelClosedError(err) from None
+                continue
+            # frames are seq-stamped: drop stale ones left by an
+            # earlier timed-out iteration (the stage still
+            # committed its result after the driver gave up)
+            # instead of returning iteration N-1's output as N
+            if seq >= self._seq:
+                return seq, data
+
     # ------------------------------------------------------------- teardown
 
     def teardown(self) -> None:
-        """Release every channel and worker pin; idempotent."""
-        if self._torn_down:
-            return
-        self._torn_down = True
+        """Release every channel and worker pin; idempotent (and
+        serialized: gc can drive ``__del__``-teardown on an arbitrary
+        thread while the owner calls it explicitly)."""
+        with self._life_lock:
+            if self._torn_down:
+                return
+            self._torn_down = True
         for ch in self._inputs:
             try:
                 ch.close()  # graceful CLOSED: stages drain, then exit

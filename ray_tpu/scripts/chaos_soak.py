@@ -71,6 +71,14 @@ ap.add_argument("--race", action="store_true",
                      "lock/thread/queue/executor edge vector-clocked; "
                      "EXITS 1 on any detected race, with both access "
                      "stacks in a race-*.jsonl artifact")
+ap.add_argument("--stall", action="store_true",
+                help="run the whole soak under the wait-graph deadlock & "
+                     "stall sanitizer (analysis/waitgraph.py): every "
+                     "lock/queue/future/executor wait and channel park "
+                     "edges into a live cross-thread wait-for graph; "
+                     "EXITS 1 on any deadlock report or any unattributed "
+                     "stall > 30s, with stacks in a waitgraph-*.jsonl "
+                     "artifact")
 ap.add_argument("--gray", action="store_true",
                 help="mix seeded gray failures into the fault plane: a "
                      "probabilistic chaos ``slow`` rule stretches task "
@@ -120,6 +128,17 @@ if args.race:
 
     race_san = _racer.RaceSanitizer().install()
     assert not race_san.unresolved, race_san.unresolved
+
+# --stall: the wait-graph sanitizer rides the whole soak maintaining the
+# live wait-for graph. Installed BEFORE the cluster exists (same rule as
+# the racer: every lock/queue/executor/channel the control plane
+# allocates must be instrumented from birth); its stall watchdog
+# attributes any wait older than 30s into waitgraph-*.jsonl artifacts.
+wait_san = None
+if args.stall:
+    from ray_tpu.analysis import waitgraph as _waitgraph
+
+    wait_san = _waitgraph.WaitSanitizer(stall_warn_s=30.0).install()
 
 # Per-operation RPC accounting rides the whole soak (analysis/rpcflow):
 # installed LAST so it wraps whichever tracer is active (the invariant
@@ -437,6 +456,22 @@ if race_san is not None:
     if races:
         print(race_san.format_races(), flush=True)
         print("race artifact:", race_san.dump("chaos-soak"), flush=True)
+deadlocks, bad_stalls = [], []
+if wait_san is not None:
+    wait_san.uninstall()
+    deadlocks = wait_san.deadlocks
+    # queue/cond idle-consumer waits and attributed channel parks are
+    # soak noise; a LOCK/future/rpc wait no one resolvably holds for
+    # >30s is a liveness failure even if it eventually unwedged
+    bad_stalls = [s for s in wait_san.stalls
+                  if s.get("unattributed") and s.get("age_s", 0.0) > 30.0]
+    print("wait sanitizer: %d deadlock report(s), %d stall report(s) "
+          "(%d unattributed > 30s)"
+          % (len(deadlocks), len(wait_san.stalls), len(bad_stalls)),
+          flush=True)
+    if deadlocks or bad_stalls:
+        print("waitgraph artifact:", wait_san.dump("chaos-soak"),
+              flush=True)
 invariants.uninstall()
 violations = invariants.check_trace(trace_path)
 print("protocol trace: %s (%d violations)" % (trace_path, len(violations)),
@@ -493,6 +528,10 @@ if violations or stats["errors"]:
 if races:
     # the race sanitizer's contract mirrors the invariant checker's:
     # a detected race is a correctness failure, never soak noise
+    raise SystemExit(1)
+if deadlocks or bad_stalls:
+    # the wait sanitizer's contract: a wait cycle or an unattributed
+    # >30s stall is a liveness failure, never soak noise
     raise SystemExit(1)
 if violations:
     raise SystemExit(1)

@@ -78,9 +78,14 @@ def cmd_start(args):
         return
 
     # --block: run the node in THIS process
+    from ray_tpu.analysis import waitgraph
     from ray_tpu.cluster.gcs import GcsServer
     from ray_tpu.cluster.node_daemon import NodeDaemon
 
+    # `ray_tpu stacks` protocol: SIGUSR1 makes this process write an
+    # annotated all-thread stack dump artifact (wait edges + held locks
+    # when the wait sanitizer is live, plain stacks otherwise)
+    waitgraph.install_stack_signal()
     if args.head:
         gcs = GcsServer(host="127.0.0.1", port=args.port or 0)
         addr = f"127.0.0.1:{gcs.port}"
@@ -130,6 +135,70 @@ def _connect(args):
 
     ray_tpu.init(address=_resolve_address(args), ignore_reinit_error=True)
     return ray_tpu
+
+
+def cmd_stacks(args):
+    """Dump all-thread stacks of every locally-started cluster process,
+    annotated with the wait sanitizer's current wait edges and held
+    locks (the observability face of the wait graph). Protocol: SIGUSR1
+    each pid in the session pid file; each process's waitgraph signal
+    handler writes a ``waitgraph-<pid>-stacks-*.jsonl`` artifact, which
+    this command collects and pretty-prints."""
+    from ray_tpu.analysis import waitgraph
+
+    if not os.path.exists(_PID_FILE):
+        sys.exit("no local session (start a node with `ray_tpu start` "
+                 "first)")
+    t0 = time.time()
+    pids = []
+    for line in open(_PID_FILE):
+        try:
+            pid = int(line.strip())
+            os.kill(pid, signal.SIGUSR1)
+            pids.append(pid)
+        except (OSError, ValueError):
+            pass
+    if not pids:
+        sys.exit("no live locally-started cluster process to signal")
+    art_dir = args.artifact_dir or os.environ.get(
+        "RAY_TPU_FLIGHTREC_DIR", "artifacts")
+    found = {}
+    deadline = t0 + args.timeout
+    while time.time() < deadline and len(found) < len(pids):
+        if os.path.isdir(art_dir):
+            for name in sorted(os.listdir(art_dir)):
+                if not name.startswith("waitgraph-") \
+                        or "-stacks-" not in name:
+                    continue
+                try:
+                    pid = int(name.split("-")[1])
+                except (IndexError, ValueError):
+                    continue
+                path = os.path.join(art_dir, name)
+                # only dumps written in RESPONSE to this signal round:
+                # a stale artifact would report last week's stacks
+                if pid in pids and pid not in found \
+                        and os.path.getmtime(path) >= t0 - 1.0:
+                    found[pid] = path
+        time.sleep(0.1)
+    if not found:
+        sys.exit(f"signalled {len(pids)} process(es) but no stack dump "
+                 f"appeared under {art_dir}/ within {args.timeout:.0f}s "
+                 "(the node must run in the same working directory, or "
+                 "set RAY_TPU_FLIGHTREC_DIR)")
+    fmt = waitgraph.WaitSanitizer()  # formatting only, never installed
+    for pid, path in sorted(found.items()):
+        entries = []
+        with open(path) as f:
+            for ln in f:
+                e = json.loads(ln)
+                if e.get("kind") != "waitgraph-stacks":
+                    entries.append(e)
+        print(f"== pid {pid} — {len(entries)} thread(s) ({path})")
+        print(fmt.format_stacks(entries))
+    missing = sorted(set(pids) - set(found))
+    if missing:
+        sys.exit(f"no dump from pid(s) {missing}")
 
 
 def cmd_status(args):
@@ -339,6 +408,18 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser("stop", help="stop locally started nodes")
     sp.set_defaults(fn=cmd_stop)
+
+    sp = sub.add_parser(
+        "stacks",
+        help="dump all-thread stacks of every local cluster process, "
+             "annotated with wait edges and held locks (waitgraph)")
+    sp.add_argument("--timeout", type=float, default=2.5,
+                    help="seconds to wait for the dumps (default 2.5)")
+    sp.add_argument("--artifact-dir", default=None,
+                    help="where the node processes write waitgraph "
+                         "artifacts (default: $RAY_TPU_FLIGHTREC_DIR "
+                         "or artifacts/)")
+    sp.set_defaults(fn=cmd_stacks)
 
     for name, fn in (("status", cmd_status), ("summary", cmd_summary),
                      ("timeline", cmd_timeline), ("memory", cmd_memory)):

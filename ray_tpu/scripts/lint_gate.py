@@ -35,6 +35,15 @@ Runs, in order, failing fast with a distinct exit code per contract:
    ``SEEDED_RACES`` (the re-introduced node_daemon PR 6 fix and the
    alias-laundered fastpath lock) must be detected within <= 2
    quiescence rounds with a two-stack report (artifact: ``race.json``);
+4b2b. optionally (``--waitgraph``) the wait-graph liveness gate
+   (analysis/waitgraph.py): the static blocking graph over the control
+   plane must be cycle-free, the pragma-stripped seeded modules must
+   still fire ``blocking-wait-under-lock`` (the static tooth), the
+   clean live probes must report no deadlock (live findings get fixed,
+   never baselined), and both ``SEEDED_WAITS`` teeth must be detected
+   dynamically within <= 2 probe rounds with a two-stack report (the
+   GCS tooth additionally carrying the RPC chain) — artifact:
+   ``waitgraph.json``;
 4b3. optionally (``--rpc-budget``) the per-operation RPC budget ratchet
    (analysis/rpcflow.py): the interprocedural cost table must build with
    no unresolved entries, the committed ``.rpc-budget.json`` must pass
@@ -112,6 +121,18 @@ def main(argv=None) -> int:
                          "artifact: race.json")
     ap.add_argument("--race-rounds", type=int, default=2,
                     help="seeded-bug detection bar in quiescence "
+                         "rounds (default 2; detection is "
+                         "deterministic in round 1)")
+    ap.add_argument("--waitgraph", action="store_true",
+                    help="also run the wait-graph liveness gate "
+                         "(analysis/waitgraph.py): static blocking-"
+                         "cycle scan, the pragma-stripped seeded-tooth "
+                         "bar, clean live deadlock probes, and the "
+                         "seeded dynamic detection bar (<= 2 rounds, "
+                         "two-stack report + rpc chain); artifact: "
+                         "waitgraph.json")
+    ap.add_argument("--waitgraph-rounds", type=int, default=2,
+                    help="seeded wait-bug detection bar in probe "
                          "rounds (default 2; detection is "
                          "deterministic in round 1)")
     ap.add_argument("--rpc-budget", action="store_true",
@@ -429,6 +450,129 @@ def main(argv=None) -> int:
         if failed:
             print("lint_gate: race sanitizer gate failed",
                   file=sys.stderr)
+            return 1
+
+    # (4b2b) wait-graph liveness gate: the static blocking graph must be
+    # cycle-free, the pragma-stripped seeded modules must still fire
+    # blocking-wait-under-lock (the static tooth), the clean probes must
+    # find no live deadlock (EMPTY-baseline rule), and both seeded
+    # teeth must be caught dynamically with a two-stack report
+    if args.waitgraph:
+        import re as _re
+        import shutil as _shutil
+        import tempfile as _tempfile
+
+        from ray_tpu.analysis.core import analyze_paths as _analyze
+        from ray_tpu.analysis import waitgraph as _wg
+
+        failed = False
+        report = {"static": {}, "seeded_static": {}, "probes": {},
+                  "seeded": {}}
+
+        wg_report = _wg.build_waitgraph(root=REPO)
+        report["static"] = {
+            "contexts": len(wg_report.contexts),
+            "edges": len(wg_report.edges),
+            "cycles": [list(c) for c in wg_report.cycles],
+        }
+        if wg_report.cycles:
+            failed = True
+            for c in wg_report.cycles:
+                print("lint_gate: static blocking cycle: "
+                      + " -> ".join(c + [c[0]]), file=sys.stderr)
+        else:
+            print(f"waitgraph: static blocking graph cycle-free "
+                  f"({len(wg_report.contexts)} contexts, "
+                  f"{len(wg_report.edges)} rpc edges)")
+
+        # seeded static bar: strip every ray-lint pragma off the two
+        # seeded modules and rescan — blocking-wait-under-lock must
+        # fire in each, or the static half lost its teeth
+        seeded_mods = ("ray_tpu/cluster/gcs.py", "ray_tpu/dag/compiled.py")
+        pragma_re = _re.compile(r"#\s*ray-lint:[^\n]*")
+        tmp = _tempfile.mkdtemp(prefix="wg-gate-")
+        try:
+            for rel in seeded_mods:
+                dst = os.path.join(tmp, rel)
+                os.makedirs(os.path.dirname(dst), exist_ok=True)
+                with open(os.path.join(REPO, rel)) as f:
+                    stripped = pragma_re.sub("", f.read())
+                with open(dst, "w") as f:
+                    f.write(stripped)
+            res = _analyze([os.path.join(tmp, "ray_tpu")], root=tmp,
+                           select=["blocking-wait-under-lock"])
+            fired = {rel: sum(1 for f_ in res.findings if f_.path == rel)
+                     for rel in seeded_mods}
+            report["seeded_static"] = fired
+            for rel, n in fired.items():
+                if not n:
+                    failed = True
+                    print(f"lint_gate: pragma-stripped {rel} raised NO "
+                          "blocking-wait-under-lock finding — the "
+                          "static tooth is gone", file=sys.stderr)
+            if all(fired.values()):
+                print("waitgraph: pragma-stripped seeded modules fire "
+                      "blocking-wait-under-lock ("
+                      + ", ".join(f"{rel}: {n}"
+                                  for rel, n in sorted(fired.items()))
+                      + ")")
+        finally:
+            _shutil.rmtree(tmp, ignore_errors=True)
+
+        for name in sorted(_wg.WAIT_PROBES):
+            res = _wg.run_probe(name, rounds=args.waitgraph_rounds)
+            report["probes"][name] = {
+                "rounds": res.rounds,
+                "deadlocks": res.deadlocks,
+                "stalls": len(res.stalls),
+            }
+            if res.detected:
+                failed = True
+                print(f"lint_gate: wait probe {name} found a LIVE "
+                      "deadlock — fix it (the baseline stays empty)",
+                      file=sys.stderr)
+            else:
+                print(f"waitgraph: probe {name} clean "
+                      f"({res.rounds} round(s))")
+        for bug, _mod, pname in _wg.SEEDED_WAITS:
+            res = _wg.run_probe(pname, seeded_bugs=[bug],
+                                rounds=args.waitgraph_rounds)
+            rep0 = res.deadlocks[0] if res.deadlocks else {}
+            threads = rep0.get("threads", ())
+            two_stack = sum(1 for t in threads if t.get("stack")) >= 2
+            # a cycle through an rpc-srv resource must carry the
+            # Lamport-stitched chain of in-flight calls; pure
+            # lock/channel cycles have no rpc hop to report
+            needs_chain = any("rpc" in str(t.get("waiting_on", ""))
+                              for t in threads)
+            chain_ok = (not needs_chain) or bool(rep0.get("rpc_chain"))
+            ok = (res.detected and res.rounds <= args.waitgraph_rounds
+                  and two_stack and chain_ok)
+            report["seeded"][bug] = {
+                "probe": pname,
+                "detected": res.detected,
+                "rounds": res.rounds,
+                "two_stack": two_stack,
+                "rpc_chain": len(rep0.get("rpc_chain") or ()),
+            }
+            if not ok:
+                failed = True
+                print(f"lint_gate: seeded wait bug {bug!r} "
+                      + (f"took {res.rounds} rounds (> "
+                         f"{args.waitgraph_rounds}) or lost the "
+                         "two-stack/rpc-chain report" if res.detected
+                         else "NOT DETECTED")
+                      + " — the sanitizer lost its teeth",
+                      file=sys.stderr)
+            else:
+                print(f"waitgraph: seeded bug {bug} detected in "
+                      f"{res.rounds} round(s), two-stack report, "
+                      f"{report['seeded'][bug]['rpc_chain']} rpc hop(s)")
+        with open(os.path.join(args.artifact_dir, "waitgraph.json"),
+                  "w") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+        if failed:
+            print("lint_gate: wait-graph gate failed", file=sys.stderr)
             return 1
 
     # (4b3) per-operation RPC budget ratchet: static cost table ->

@@ -6,6 +6,7 @@ Multi-chip behavior is tested on a virtual 8-device CPU mesh
 (python/ray/cluster_utils.py Cluster; SURVEY §4). Must run before jax import.
 """
 
+import json
 import os
 import sys
 
@@ -100,6 +101,35 @@ def race_sanitizer():
             dump = san.dump("fixture")
             assert not san.races, (
                 "data race(s) detected:\n" + san.format_races()
+                + f"\n(artifact: {dump})"
+            )
+
+
+@pytest.fixture
+def wait_sanitizer():
+    """Opt-in distributed wait-for deadlock/stall sanitizer
+    (ray_tpu.analysis.waitgraph).
+
+    While installed, every lock/queue/future/condition wait, RPC
+    awaiting a reply, and dag-channel slow-tier park is a node in a
+    live cross-thread AND cross-process wait-for graph; a watchdog
+    probes it for cycles. At teardown the test FAILS on any detected
+    deadlock, with both stacks + held-lock sets + the RPC chain in a
+    flight-recorder-style artifact — the dynamic cross-check of the
+    static blocking graph (``--dump-waitgraph``), the same way
+    ``race_sanitizer`` cross-checks the static watchlist."""
+    from ray_tpu.analysis import waitgraph as _wg
+
+    san = _wg.WaitSanitizer(stall_warn_s=30.0).install()
+    try:
+        yield san
+    finally:
+        san.uninstall()
+        if san.deadlocks:
+            dump = san.dump("fixture")
+            assert not san.deadlocks, (
+                "deadlock(s) detected:\n"
+                + json.dumps(san.deadlocks, indent=2)
                 + f"\n(artifact: {dump})"
             )
 

@@ -160,10 +160,13 @@ def test_disabled_by_default_and_off_hot_path():
 # ================================================== live-cluster survival
 
 
-def test_job_survives_injected_gcs_connection_reset(invariant_sanitizer):
+def test_job_survives_injected_gcs_connection_reset(invariant_sanitizer,
+                                                    wait_sanitizer):
     """Acceptance (a): a driver job completes correctly across an injected
     driver->GCS connection reset — RetryingRpcClient reconnects with
-    backoff, replays subscriptions, re-registers, and resubmits."""
+    backoff, replays subscriptions, re-registers, and resubmits.
+    Runs under the wait-graph sanitizer: the retry/reconnect path must
+    not deadlock either."""
     sched = chaos.install(FaultSchedule(seed=7, rules=[
         chaos.reset(src="driver-*", dst="gcs", at=4, hook="client_send"),
     ]))
@@ -218,7 +221,8 @@ def test_job_survives_daemon_gcs_reset(invariant_sanitizer):
         cluster.shutdown()
 
 
-def test_job_survives_gcs_kill_restart_midjob(tmp_path, invariant_sanitizer):
+def test_job_survives_gcs_kill_restart_midjob(tmp_path, invariant_sanitizer,
+                                              wait_sanitizer):
     """Acceptance (b): full GCS kill + restart mid-job. In-flight work
     finishes with correct results: daemons/drivers reconnect + re-register,
     the driver resubmits unfinished tasks, the GCS recovers tables from its

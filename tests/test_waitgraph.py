@@ -1,0 +1,843 @@
+"""ray_tpu.analysis.waitgraph — static blocking-cycle analysis +
+distributed wait-for deadlock & stall sanitizer.
+
+Covers: the blocking-site classifier (every kind + the precision
+exclusions), the static blocking graph (context roots, cross-process
+RPC edge resolution, the method-name over-approximation, executor
+offload and seeded-branch invisibility, determinism), the two checkers
+(`blocking-wait-under-lock` incl. the condition-idiom exemption,
+`rpc-reentry-cycle` incl. multi-line pragma ranges), the dynamic
+wait-for core (lock-lock / lock-future cycles, RLock reentry, report
+shape + dedup), the install/uninstall zero-overhead contract, the
+seeded teeth (both probes, both layers, the <= 2 round bar), the stall
+watchdog + artifact formats (channel attribution, `ray_tpu stacks`
+payload), and the CLI exit-code contract.
+"""
+
+import json
+import os
+import queue
+import signal
+import tempfile
+import textwrap
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FutTimeout
+
+import pytest
+
+from ray_tpu.analysis import sanitizer as san_mod
+from ray_tpu.analysis import waitgraph as wg
+from ray_tpu.analysis.core import analyze_paths
+from ray_tpu.analysis.waitgraph import (
+    WaitSanitizer,
+    blocking_wait_kind,
+    build_waitgraph,
+    reentry_chains,
+    run_probe,
+)
+
+import ast
+
+
+# ===================================================== site classifier
+
+
+def kind_of(expr):
+    node = ast.parse(textwrap.dedent(expr)).body[0].value
+    return blocking_wait_kind(node)
+
+
+def test_kind_rpc_call_literal_method():
+    assert kind_of('x.call("submit_task", {"a": 1})') == \
+        ("rpc-call", "submit_task")
+
+
+def test_kind_rpc_call_dynamic_method_unclassified():
+    assert kind_of("x.call(method)") is None
+
+
+def test_kind_chained_call_async_result():
+    assert kind_of('x.call_async("ping", p).result(timeout=2)') == \
+        ("rpc-result", "ping")
+
+
+def test_kind_future_result_bare_only():
+    assert kind_of("f.result()") == ("future-result", None)
+    assert kind_of("f.result(timeout=3)") == ("future-result", None)
+    # a positional arg is some other API's result(key)
+    assert kind_of("f.result(3)") is None
+
+
+def test_kind_cond_wait_excludes_result_collection_wait():
+    assert kind_of("cv.wait()") == ("cond-wait", None)
+    assert kind_of("ev.wait(2.0)") == ("cond-wait", None)
+    assert kind_of("ev.wait(timeout=2.0)") == ("cond-wait", None)
+    # regression (serve/handle.py): ray_tpu.wait(refs, num_returns=...,
+    # timeout=0) is result collection, not a condition park
+    assert kind_of(
+        "ray_tpu.wait(refs, num_returns=1, timeout=0)") is None
+
+
+def test_kind_queue_get_excludes_dict_get():
+    assert kind_of("q.get()") == ("queue-get", None)
+    assert kind_of("q.get(timeout=1)") == ("queue-get", None)
+    assert kind_of("d.get(key)") is None
+
+
+def test_kind_thread_join_excludes_str_join():
+    assert kind_of("t.join()") == ("thread-join", None)
+    assert kind_of("sep.join(parts)") is None
+
+
+def test_kind_channel_wait_signature():
+    assert kind_of("ch.read(timeout=1.0)") == ("chan-read", None)
+    assert kind_of("ch.write(b, should_stop=fn)") == ("chan-write", None)
+    # a bare file read never carries the channel wait signature
+    assert kind_of("fh.read()") is None
+
+
+# ================================================= static blocking graph
+
+
+def graph(tmp_path, **modules):
+    """Build the blocking graph over a synthetic tree:
+    ``gcs="..."`` writes cluster/gcs.py (server label "gcs"),
+    ``node_daemon="..."`` writes cluster/node_daemon.py ("daemon")."""
+    d = tmp_path / "cluster"
+    d.mkdir(exist_ok=True)
+    for name, src in modules.items():
+        (d / f"{name}.py").write_text(textwrap.dedent(src))
+    return build_waitgraph([str(tmp_path)], root=str(tmp_path))
+
+
+def test_contexts_and_sites_extracted(tmp_path):
+    r = graph(tmp_path, gcs="""
+        class GcsServer:
+            def rpc_drain(self, payload, client):
+                return self.q.get()
+
+            def _sweeper_loop(self):
+                self.done.wait(1.0)
+        """)
+    assert "gcs.rpc_drain" in r.contexts
+    assert [s.kind for s in r.contexts["gcs.rpc_drain"]] == ["queue-get"]
+    thread_label = "gcs.GcsServer._sweeper_loop"
+    assert [s.kind for s in r.contexts[thread_label]] == ["cond-wait"]
+
+
+def test_cross_process_edge_and_cycle(tmp_path):
+    r = graph(
+        tmp_path,
+        gcs="""
+        class GcsServer:
+            def rpc_ping(self, payload, client):
+                return self.daemon.call("pong", payload)
+        """,
+        node_daemon="""
+        class NodeDaemon:
+            def rpc_pong(self, payload, client):
+                return self.gcs.call("ping", payload)
+        """,
+    )
+    assert ("gcs.rpc_ping", "daemon.rpc_pong") in r.edges
+    assert ("daemon.rpc_pong", "gcs.rpc_ping") in r.edges
+    assert any(set(c) == {"gcs.rpc_ping", "daemon.rpc_pong"}
+               for c in r.cycles)
+
+
+def test_interprocedural_site_through_helper(tmp_path):
+    r = graph(tmp_path, gcs="""
+        class GcsServer:
+            def rpc_sync(self, payload, client):
+                return self._push()
+
+            def _push(self):
+                return self.daemon.call_async("apply", {}).result(
+                    timeout=2.0)
+        """)
+    sites = r.contexts["gcs.rpc_sync"]
+    assert [(s.kind, s.method, s.via) for s in sites] == \
+        [("rpc-result", "apply", ("_push",))]
+
+
+def test_method_name_over_approximation_edges_every_server(tmp_path):
+    # documented known limit: .call("m") edges into EVERY server
+    # defining rpc_m — better a spurious edge than a missed cycle
+    r = graph(
+        tmp_path,
+        gcs="""
+        class GcsServer:
+            def rpc_kick(self, payload, client):
+                return self.peer.call("status", {})
+
+            def rpc_status(self, payload, client):
+                return {}
+        """,
+        node_daemon="""
+        class NodeDaemon:
+            def rpc_status(self, payload, client):
+                return {}
+        """,
+    )
+    dsts = {dst for (src, dst) in r.edges if src == "gcs.rpc_kick"}
+    assert dsts == {"gcs.rpc_status", "daemon.rpc_status"}
+
+
+def test_executor_offloaded_wait_not_charged_to_handler(tmp_path):
+    # regression (node_daemon object pull): a handler that offloads its
+    # blocking work to the executor and returns the future does not
+    # block the dispatcher
+    r = graph(tmp_path, gcs="""
+        class GcsServer:
+            def rpc_pull(self, payload, client):
+                return self.loop.run_in_executor(
+                    None, lambda: self.peer.call("fetch", payload))
+        """)
+    assert r.contexts["gcs.rpc_pull"] == []
+
+
+def test_seeded_branch_invisible_to_graph(tmp_path):
+    r = graph(tmp_path, gcs="""
+        SEEDED_BUGS = set()
+
+        class GcsServer:
+            def rpc_ack(self, payload, client):
+                if "tooth" in SEEDED_BUGS and payload:
+                    self.peer.call_async("ack", {}).result(timeout=2)
+                return self.q.get()
+        """)
+    kinds = [s.kind for s in r.contexts["gcs.rpc_ack"]]
+    assert kinds == ["queue-get"]  # the armed-only branch is invisible
+
+
+def test_build_waitgraph_raises_on_unparseable(tmp_path):
+    d = tmp_path / "cluster"
+    d.mkdir()
+    (d / "gcs.py").write_text("def broken(:\n")
+    with pytest.raises(ValueError, match="unparseable"):
+        build_waitgraph([str(tmp_path)], root=str(tmp_path))
+
+
+def test_report_to_dict_json_and_deterministic(tmp_path):
+    src = dict(
+        gcs="""
+        class GcsServer:
+            def rpc_ping(self, payload, client):
+                return self.daemon.call("pong", payload)
+        """,
+        node_daemon="""
+        class NodeDaemon:
+            def rpc_pong(self, payload, client):
+                return self.gcs.call("ping", payload)
+        """,
+    )
+    a = json.dumps(graph(tmp_path, **src).to_dict(), sort_keys=True)
+    b = json.dumps(graph(tmp_path, **src).to_dict(), sort_keys=True)
+    assert a == b
+    d = json.loads(a)
+    assert set(d) == {"contexts", "edges", "cycles"}
+    assert all(set(e) == {"src", "dst", "path", "line", "kind", "method"}
+               for e in d["edges"])
+
+
+def test_reentry_chains_report_origin_and_site(tmp_path):
+    r = graph(tmp_path, gcs="""
+        class GcsServer:
+            def rpc_fanout(self, payload, client):
+                return self.peer.call_async("fanout", {}).result(
+                    timeout=2.0)
+        """)
+    chains = reentry_chains(r)
+    assert len(chains) == 1
+    assert chains[0]["origin"] == "gcs.rpc_fanout"
+    assert chains[0]["chain"] == ["gcs.rpc_fanout", "gcs.rpc_fanout"]
+    assert chains[0]["site"].method == "fanout"
+
+
+def test_repo_graph_is_cycle_free():
+    # the live baseline the lint gate enforces: the control plane's
+    # NORMAL-path blocking graph has no cross-process cycle
+    r = build_waitgraph()
+    assert r.cycles == []
+    assert r.contexts and r.edges  # non-vacuous: real roots + rpc edges
+
+
+# ============================================================= checkers
+
+
+def lint(tmp_path, source, select, name="gcs.py"):
+    d = tmp_path / "cluster"
+    d.mkdir(exist_ok=True)
+    (d / name).write_text(textwrap.dedent(source))
+    res = analyze_paths([str(tmp_path)], root=str(tmp_path),
+                        select=select)
+    assert not res.errors, res.errors
+    return res.findings
+
+
+def test_wait_under_lock_fires_on_queue_get(tmp_path):
+    fs = lint(tmp_path, """
+        import threading
+
+        class Server:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.q = object()
+
+            def drain(self):
+                with self._lock:
+                    return self.q.get()
+        """, ["blocking-wait-under-lock"])
+    assert [f.check for f in fs] == ["blocking-wait-under-lock"]
+    assert "queue-get" in fs[0].message
+
+
+def test_wait_under_lock_condition_idiom_exempt(tmp_path):
+    # `with self._cv: self._cv.wait()` RELEASES the lock it waits on
+    fs = lint(tmp_path, """
+        import threading
+
+        class Server:
+            def __init__(self):
+                self._cv = threading.Condition()
+
+            def park(self):
+                with self._cv:
+                    self._cv.wait(1.0)
+        """, ["blocking-wait-under-lock"])
+    assert fs == []
+
+
+def test_wait_under_lock_cond_wait_under_other_lock_fires(tmp_path):
+    fs = lint(tmp_path, """
+        import threading
+
+        class Server:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition()
+
+            def park(self):
+                with self._lock:
+                    with self._cv:
+                        self._cv.wait(1.0)
+        """, ["blocking-wait-under-lock"])
+    assert [f.check for f in fs] == ["blocking-wait-under-lock"]
+
+
+def test_wait_under_lock_reached_from_locked_caller(tmp_path):
+    fs = lint(tmp_path, """
+        import threading
+
+        class Server:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def entry(self):
+                with self._lock:
+                    self._join_locked()
+
+            def _join_locked(self):
+                self.worker.join()
+        """, ["blocking-wait-under-lock"])
+    assert [f.check for f in fs] == ["blocking-wait-under-lock"]
+    assert "thread-join" in fs[0].message
+
+
+def test_wait_under_lock_pragma_suppresses(tmp_path):
+    fs = lint(tmp_path, """
+        import threading
+
+        class Server:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def drain(self):
+                with self._lock:
+                    return self.q.get()  # ray-lint: disable=blocking-wait-under-lock
+        """, ["blocking-wait-under-lock"])
+    assert fs == []
+
+
+def test_rpc_reentry_cycle_fires_and_names_chain(tmp_path):
+    fs = lint(tmp_path, """
+        class GcsServer:
+            def rpc_fanout(self, payload, client):
+                return self.peer.call_async("fanout", {}).result(
+                    timeout=2.0)
+        """, ["rpc-reentry-cycle"])
+    assert [f.check for f in fs] == ["rpc-reentry-cycle"]
+    assert "gcs.rpc_fanout" in fs[0].message
+
+
+def test_rpc_reentry_pragma_on_multiline_call_end_line(tmp_path):
+    # regression: the finding must carry end_line so a pragma on the
+    # CLOSING line of a multi-line chained call suppresses it
+    fs = lint(tmp_path, """
+        class GcsServer:
+            def rpc_fanout(self, payload, client):
+                return self.peer.call_async("fanout", {}).result(
+                    timeout=2.0)  # ray-lint: disable=rpc-reentry-cycle
+        """, ["rpc-reentry-cycle"])
+    assert fs == []
+
+
+def test_repo_checker_baseline_empty():
+    res = analyze_paths(
+        [os.path.join(wg._REPO, "ray_tpu")], root=wg._REPO,
+        select=["blocking-wait-under-lock", "rpc-reentry-cycle"])
+    assert not res.errors, res.errors
+    assert res.findings == []  # live findings get FIXED, never baselined
+
+
+def test_seeded_teeth_fire_statically_when_pragmas_stripped(tmp_path):
+    # the static half of both teeth: the in-tree pragmas are the ONLY
+    # thing keeping the seeded sites out of the baseline
+    import re
+
+    for rel in ("ray_tpu/cluster/gcs.py", "ray_tpu/dag/compiled.py"):
+        src = open(os.path.join(wg._REPO, rel)).read()
+        dst = tmp_path / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        dst.write_text(re.sub(r"#\s*ray-lint:[^\n]*", "", src))
+    res = analyze_paths([str(tmp_path / "ray_tpu")], root=str(tmp_path),
+                        select=["blocking-wait-under-lock"])
+    hit = {f.path for f in res.findings}
+    assert "ray_tpu/cluster/gcs.py" in hit
+    assert "ray_tpu/dag/compiled.py" in hit
+
+
+# ======================================================== dynamic core
+
+
+def _spin_until(pred, timeout=8.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+@pytest.fixture
+def san():
+    s = WaitSanitizer(stall_warn_s=60.0).install()
+    try:
+        yield s
+    finally:
+        s.uninstall()
+
+
+def _ab_ba(la, lb):
+    """Drive the classic two-lock inversion; both sides give up on a
+    timeout so the test never actually hangs."""
+    barrier = threading.Barrier(2)
+
+    def one():
+        la.acquire()
+        barrier.wait(5.0)
+        if lb.acquire(timeout=4.0):
+            lb.release()
+        la.release()
+
+    def two():
+        lb.acquire()
+        barrier.wait(5.0)
+        if la.acquire(timeout=4.0):
+            la.release()
+        lb.release()
+
+    t1 = threading.Thread(target=one, name="wg-ab")
+    t2 = threading.Thread(target=two, name="wg-ba")
+    t1.start()
+    t2.start()
+    t1.join(10.0)
+    t2.join(10.0)
+
+
+def test_lock_lock_deadlock_detected_and_report_shape(san):
+    la, lb = threading.Lock(), threading.Lock()
+    _ab_ba(la, lb)
+    assert len(san.deadlocks) == 1
+    rep = san.deadlocks[0]
+    assert rep["kind"] == "deadlock"
+    assert rep["pid"] == os.getpid()
+    assert len(rep["cycle"]) == 2
+    assert all(d.startswith("lock ") for d in rep["cycle"])
+    names = {t["thread"] for t in rep["threads"]}
+    assert names == {"wg-ab", "wg-ba"}
+    for t in rep["threads"]:
+        assert t["stack"], "each side must carry a live stack"
+        assert t["held"], "each side holds the lock the other wants"
+        assert t["waiting_on"].startswith("lock ")
+    assert san.found
+
+
+def test_same_cycle_deduplicated(san):
+    la, lb = threading.Lock(), threading.Lock()
+    _ab_ba(la, lb)
+    _ab_ba(la, lb)  # same resources -> same cycle key
+    assert len(san.deadlocks) == 1
+
+
+def test_ordered_locks_no_false_positive(san):
+    la, lb = threading.Lock(), threading.Lock()
+
+    def worker():
+        for _ in range(50):
+            with la:
+                with lb:
+                    pass
+
+    ts = [threading.Thread(target=worker) for _ in range(3)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(10.0)
+    assert san.deadlocks == []
+
+
+def test_rlock_reacquire_is_not_a_cycle(san):
+    rl = threading.RLock()
+    with rl:
+        with rl:  # an owner re-acquiring never parks
+            pass
+    assert san.deadlocks == []
+
+
+def test_lock_future_cycle_via_executor_box(san):
+    # main holds the lock and blocks on a future whose task needs it:
+    # the submit() box resolves the future's owner to the pool thread
+    lk = threading.Lock()
+    with ThreadPoolExecutor(max_workers=1) as ex:
+        lk.acquire()
+        fut = ex.submit(lambda: lk.acquire(timeout=4.0) and
+                        (lk.release() or True))
+        assert _spin_until(lambda: any(
+            r["res"] == ("lock", id(lk))
+            for st in san._waits.values() for r in st))
+        with pytest.raises(FutTimeout):
+            fut.result(timeout=2.0)
+        lk.release()
+        fut.result(timeout=4.0)
+    assert len(san.deadlocks) == 1
+    kinds = {r.split(" ")[0] for r in san.deadlocks[0]["cycle"]}
+    assert kinds == {"lock", "future.result"}
+
+
+def test_dump_stacks_annotates_waits(san):
+    q = queue.Queue()
+    t = threading.Thread(target=lambda: q.get(timeout=4.0),
+                         name="wg-consumer", daemon=True)
+    t.start()
+    assert _spin_until(lambda: any(
+        r["res"][0] == "queue"
+        for st in san._waits.values() for r in st))
+    stacks = san.dump_stacks()
+    me = {e["thread"]: e for e in stacks}
+    # the wait stack nests: queue.get parks on its internal Condition
+    waiting = me["wg-consumer"]["waiting_on"]
+    assert waiting[0].startswith("queue.get")
+    assert waiting[-1].startswith("condition.wait")
+    text = san.format_stacks(stacks)
+    assert "wg-consumer" in text and "WAITING on condition.wait" in text
+    q.put(None)
+    t.join(5.0)
+
+
+# ========================================== install/uninstall contract
+
+
+def test_uninstalled_zero_consults():
+    before = wg.CONSULTS
+    lk = threading.Lock()
+    lk.acquire()
+    lk.release()
+    q = queue.Queue()
+    q.put(1)
+    q.get()
+    ev = threading.Event()
+    ev.set()
+    ev.wait(0.01)
+    with ThreadPoolExecutor(max_workers=1) as ex:
+        ex.submit(lambda: None).result()
+    d = tempfile.mkdtemp(prefix="wg-test-")
+    from ray_tpu.dag.channel import Channel
+
+    ch = Channel.create(os.path.join(d, "ch"), 4096, "wg-test")
+    ch.write(b"x", timeout=2)
+    ch.read(timeout=2)
+    ch.close()
+    ch.detach()
+    assert wg.CONSULTS == before
+
+
+def test_uninstall_restores_everything():
+    import concurrent.futures as cf
+
+    from ray_tpu.cluster import rpc as rpc_mod
+    from ray_tpu.dag import channel as chan_mod
+
+    real_cond = san_mod._real_factories()[2]
+    orig = (queue.Queue.get, cf.ThreadPoolExecutor.submit,
+            cf.Future.result, real_cond.wait, rpc_mod.TRACE,
+            chan_mod.PARKWATCH)
+    s = WaitSanitizer().install()
+    assert queue.Queue.get is not orig[0]
+    assert rpc_mod.TRACE is s and chan_mod.PARKWATCH is s
+    s.uninstall()
+    assert (queue.Queue.get, cf.ThreadPoolExecutor.submit,
+            cf.Future.result, real_cond.wait, rpc_mod.TRACE,
+            chan_mod.PARKWATCH) == orig
+    assert wg.WAITGRAPH is None
+    assert s._watchdog is None  # watchdog joined, not leaked
+
+
+def test_single_sanitizer_at_a_time():
+    a = WaitSanitizer().install()
+    try:
+        with pytest.raises(RuntimeError, match="already installed"):
+            WaitSanitizer().install()
+    finally:
+        a.uninstall()
+
+
+def test_context_manager_installs_and_uninstalls():
+    with WaitSanitizer() as s:
+        assert wg.WAITGRAPH is s
+    assert wg.WAITGRAPH is None
+
+
+# ========================================================= seeded teeth
+
+
+def test_probe_gcs_clean():
+    r = run_probe("gcs-stream-ack-reentry", rounds=2)
+    assert not r.detected
+    assert r.rounds == 2 and r.deadlocks == []
+    assert "clean" in r.summary()
+
+
+def test_probe_gcs_seeded_detects_with_rpc_chain():
+    from ray_tpu.cluster import gcs as gcs_mod
+
+    before = set(gcs_mod.SEEDED_BUGS)
+    r = run_probe("gcs-stream-ack-reentry",
+                  seeded_bugs=("stream-ack-under-lock",), rounds=3)
+    assert r.detected and r.rounds <= 2  # the lint-gate bar
+    rep = r.deadlocks[0]
+    assert len(rep["threads"]) == 2
+    assert all(t["stack"] for t in rep["threads"])
+    assert any(e["method"] == "stream_ack" for e in rep["rpc_chain"])
+    assert gcs_mod.SEEDED_BUGS == before  # probe restores the seed set
+
+
+def test_probe_dag_clean():
+    r = run_probe("dag-read-under-lock", rounds=2)
+    assert not r.detected and r.deadlocks == []
+
+
+def test_probe_dag_seeded_detects_lock_channel_cycle():
+    from ray_tpu.dag import compiled as compiled_mod
+
+    before = set(compiled_mod.SEEDED_BUGS)
+    r = run_probe("dag-read-under-lock",
+                  seeded_bugs=("chan-read-under-lock",), rounds=3)
+    assert r.detected and r.rounds <= 2
+    rep = r.deadlocks[0]
+    assert len(rep["threads"]) == 2
+    assert all(t["stack"] for t in rep["threads"])
+    kinds = {c.split(" ")[0].split(".")[0] for c in rep["cycle"]}
+    assert "channel" in kinds and "lock" in kinds
+    assert compiled_mod.SEEDED_BUGS == before
+
+
+def test_probe_unknown_name_and_seed_rejected():
+    with pytest.raises(ValueError, match="unknown wait probe"):
+        run_probe("no-such-probe")
+    with pytest.raises(ValueError, match="unknown seeded wait"):
+        run_probe("gcs-stream-ack-reentry", seeded_bugs=("typo",))
+
+
+# ============================================ stall watchdog + artifacts
+
+
+def test_stall_report_and_artifact(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAY_TPU_FLIGHTREC_DIR", str(tmp_path))
+    s = WaitSanitizer(stall_warn_s=0.3, watchdog_interval_s=0.05)
+    s.install()
+    try:
+        q = queue.Queue()
+        t = threading.Thread(target=lambda: q.get(timeout=5.0),
+                             name="wg-staller", daemon=True)
+        t.start()
+        assert _spin_until(lambda: s.stalls, timeout=6.0)
+        q.put(None)
+        t.join(5.0)
+    finally:
+        s.uninstall()
+    entry = s.stalls[0]
+    assert entry["thread"] == "wg-staller"
+    # the scanner attributes the OUTERMOST (API-level) wait, not the
+    # internal Condition that queue.get parks on
+    assert entry["resource"].startswith("queue.get")
+    assert entry["age_s"] >= 0.3
+    # queue waits are idle-consumer shapes, never "unattributed"
+    assert entry["unattributed"] is False
+    assert entry["stacks"]
+    arts = [p for p in os.listdir(tmp_path)
+            if p.startswith(f"waitgraph-{os.getpid()}-stall-")]
+    assert arts
+    lines = open(tmp_path / sorted(arts)[-1]).read().splitlines()
+    head = json.loads(lines[0])
+    assert head["kind"] == "waitgraph-report"
+    assert head["pid"] == os.getpid() and head["stalls"] >= 1
+    assert any(json.loads(ln)["kind"] == "stall" for ln in lines[1:])
+
+
+def test_unresolvable_future_stall_is_unattributed(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAY_TPU_FLIGHTREC_DIR", str(tmp_path))
+    s = WaitSanitizer(stall_warn_s=0.3, watchdog_interval_s=0.05)
+    s.install()
+    try:
+        fut = Future()  # never submitted: no owner box to resolve
+
+        def block():
+            try:
+                fut.result(timeout=3.0)
+            except FutTimeout:
+                pass
+
+        t = threading.Thread(target=block, daemon=True)
+        t.start()
+        assert _spin_until(lambda: s.stalls, timeout=6.0)
+        fut.set_result(None)
+        t.join(5.0)
+    finally:
+        s.uninstall()
+    assert s.stalls[0]["unattributed"] is True
+    assert s.stalls[0]["holder"] is None
+
+
+def test_channel_stall_attribution(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAY_TPU_FLIGHTREC_DIR", str(tmp_path))
+    from ray_tpu.dag.channel import Channel
+
+    ch = Channel.create(str(tmp_path / "ch"), 4096, "wg-stall-chan")
+    # attach the reader end so the creator end's peer_pid resolves
+    rd = Channel.open_wait(str(tmp_path / "ch"), "wg-stall-chan",
+                           timeout=2.0)
+    s = WaitSanitizer(stall_warn_s=0.3, watchdog_interval_s=0.05)
+    s.install()
+    try:
+        t = threading.Thread(target=lambda: ch.read(timeout=4.0),
+                             name="wg-chan-reader", daemon=True)
+        t.start()  # nothing written: the read crosses the slow park tier
+        assert _spin_until(lambda: s.stalls, timeout=6.0)
+        ch.write(b"unblock", timeout=2.0)
+        t.join(5.0)
+    finally:
+        s.uninstall()
+        ch.close()
+        ch.detach()
+        rd.detach()
+    entry = s.stalls[0]
+    attr = entry["channel"]
+    assert attr["key"] == "wg-stall-chan" and attr["op"] == "read"
+    assert attr["version"] == 0  # nothing had been written yet
+    assert attr["peer_pid"] == os.getpid()  # writer end = this process
+    assert entry["unattributed"] is False  # channel waits self-attribute
+
+
+def test_stacks_artifact_and_signal_protocol(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAY_TPU_FLIGHTREC_DIR", str(tmp_path))
+    prev = signal.getsignal(signal.SIGUSR2)
+    wg.install_stack_signal(signal.SIGUSR2)
+    try:
+        os.kill(os.getpid(), signal.SIGUSR2)
+        assert _spin_until(lambda: any(
+            p.startswith(f"waitgraph-{os.getpid()}-stacks-")
+            for p in os.listdir(tmp_path)))
+    finally:
+        signal.signal(signal.SIGUSR2, prev)
+    art = sorted(p for p in os.listdir(tmp_path)
+                 if p.startswith(f"waitgraph-{os.getpid()}-stacks-"))[-1]
+    lines = open(tmp_path / art).read().splitlines()
+    head = json.loads(lines[0])
+    assert head == {"kind": "waitgraph-stacks", "pid": os.getpid()}
+    entries = [json.loads(ln) for ln in lines[1:]]
+    assert any(e["thread"] == "MainThread" for e in entries)
+    assert all({"tid", "thread", "waiting_on", "held", "stack"} <= set(e)
+               for e in entries)
+    # the CLI formats collected dumps on a NEVER-installed instance
+    text = WaitSanitizer().format_stacks(entries)
+    assert "MainThread" in text
+
+
+# ================================================================== CLI
+
+
+def _cli(argv):
+    from ray_tpu.analysis.__main__ import main
+
+    return main(argv)
+
+
+def test_cli_wait_unknown_probe(capsys):
+    assert _cli(["--wait", "no-such-probe"]) == 2
+
+
+def test_cli_wait_unknown_seed_bug(capsys):
+    rc = _cli(["--wait", "gcs-stream-ack-reentry",
+               "--seed-bug", "no-such-bug"])
+    assert rc == 2
+    assert "unknown seeded wait" in capsys.readouterr().err
+
+
+def test_cli_wait_clean_exit_zero(capsys):
+    assert _cli(["--wait", "gcs-stream-ack-reentry",
+                 "--rounds", "1"]) == 0
+
+
+def test_cli_wait_seeded_detects(capsys):
+    rc = _cli(["--wait", "dag-read-under-lock",
+               "--seed-bug", "chan-read-under-lock"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "DEADLOCK" in out
+
+
+def test_cli_dump_waitgraph(tmp_path, capsys):
+    d = tmp_path / "cluster"
+    d.mkdir()
+    (d / "gcs.py").write_text(textwrap.dedent("""
+        class GcsServer:
+            def rpc_drain(self, payload, client):
+                return self.q.get()
+        """))
+    rc = _cli(["--dump-waitgraph", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    rep = json.loads(out)
+    assert "gcs.rpc_drain" in rep["contexts"]
+    assert rep["cycles"] == []
+
+
+def test_cli_list_scenarios_includes_waitgraph(capsys):
+    rc = _cli(["--list-scenarios"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "waitgraph:gcs-stream-ack-reentry" in out
+    assert "waitgraph:dag-read-under-lock" in out
+
+
+def test_cli_stacks_no_session_exits_nonzero(tmp_path, monkeypatch):
+    from ray_tpu.scripts import cli as cli_mod
+
+    monkeypatch.setattr(cli_mod, "_PID_FILE",
+                        str(tmp_path / "no-such-pids"))
+    with pytest.raises(SystemExit) as exc:
+        cli_mod.main(["stacks"])
+    assert exc.value.code != 0
